@@ -1,0 +1,121 @@
+"""deepspeed_tpu — a TPU-native distributed training framework.
+
+Public API parity with the reference ``deepspeed/__init__.py``:
+``initialize()`` (:58) returns ``(engine, optimizer, dataloader, lr_scheduler)``,
+``add_config_arguments()`` (:211) wires argparse, ``init_inference()`` (:227)
+builds the inference engine. The engine is TPU-first: jitted sharded train
+steps over a jax device mesh (see runtime/engine.py).
+"""
+
+from typing import Any, Callable, Optional
+
+from deepspeed_tpu.version import __version__
+from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.parallel.mesh import build_mesh, init_distributed
+from deepspeed_tpu.parallel.topology import (PipeDataParallelTopology,
+                                             PipeModelDataParallelTopology,
+                                             ProcessTopology)
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.engine import TPUEngine, TrainState
+from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def initialize(args=None,
+               loss_fn: Optional[Callable] = None,
+               params: Any = None,
+               model=None,
+               optimizer=None,
+               lr_scheduler=None,
+               mesh=None,
+               config: Any = None,
+               config_params: Any = None,
+               training_data=None,
+               collate_fn=None,
+               param_partition_specs=None,
+               dist_init_required: Optional[bool] = None,
+               rng_seed: int = 0,
+               **kwargs):
+    """Build the training engine (reference deepspeed/__init__.py:58).
+
+    Two entry styles:
+    - functional (TPU-native): pass ``loss_fn(params, batch, rng)`` + ``params``;
+    - module: pass a flax ``model`` (``flax.linen.Module``) — it is adapted to
+      a loss_fn via ``deepspeed_tpu.models.adapter`` (the model's ``__call__``
+      must return the scalar loss).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    """
+    cfg = config if config is not None else config_params
+    if cfg is None and args is not None and hasattr(args, "deepspeed_config"):
+        cfg = args.deepspeed_config
+    if not isinstance(cfg, DeepSpeedTPUConfig):
+        cfg = DeepSpeedTPUConfig(cfg)
+
+    if dist_init_required:
+        init_distributed()
+
+    if loss_fn is None:
+        if model is None:
+            raise ValueError("initialize() needs either loss_fn+params or model")
+        from deepspeed_tpu.models.adapter import flax_module_loss_fn
+
+        loss_fn, params = flax_module_loss_fn(model, params)
+    if params is None:
+        raise ValueError("initialize() requires the initial parameter pytree")
+
+    engine = TPUEngine(loss_fn=loss_fn, params=params, config=cfg, mesh=mesh,
+                       param_partition_specs=param_partition_specs,
+                       optimizer=optimizer, lr_scheduler=lr_scheduler,
+                       rng_seed=rng_seed, **kwargs)
+
+    dataloader = None
+    if training_data is not None:
+        import jax
+
+        dataloader = DeepSpeedDataLoader(
+            training_data,
+            batch_size=cfg.train_micro_batch_size_per_gpu *
+            max(engine.dp_size // max(jax.process_count(), 1), 1),
+            data_parallel_world_size=jax.process_count(),
+            data_parallel_rank=jax.process_index(),
+            collate_fn=collate_fn)
+
+    return engine, engine.optimizer, dataloader, engine.lr_scheduler
+
+
+def add_config_arguments(parser):
+    """Argparse integration (reference deepspeed/__init__.py:211)."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag for scripts)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed-TPU JSON config")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_suppress())
+    group.add_argument("--local_rank", type=int, default=-1,
+                       help="Local rank set by the launcher")
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+
+    return argparse.SUPPRESS
+
+
+def init_inference(model=None, **kwargs):
+    """Inference engine entry (reference deepspeed/__init__.py:227)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    return InferenceEngine(model, **kwargs)
+
+
+__all__ = [
+    "initialize", "init_inference", "add_config_arguments", "init_distributed",
+    "build_mesh", "TPUEngine", "TrainState", "DeepSpeedTPUConfig",
+    "DeepSpeedDataLoader", "RepeatingLoader", "ProcessTopology",
+    "PipeDataParallelTopology", "PipeModelDataParallelTopology",
+    "add_tuning_arguments", "log_dist", "logger", "__version__",
+]

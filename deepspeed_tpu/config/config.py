@@ -1,0 +1,378 @@
+"""Top-level config system.
+
+Parity with the reference ``DeepSpeedConfig`` (``deepspeed/runtime/config.py:655``):
+one JSON document (path or dict) parsed into typed sub-configs, including the
+three-way batch-size constraint solver
+``train_batch_size = micro_batch_per_device × gradient_accumulation_steps × dp_world_size``
+(reference ``config.py:822-893``).
+
+TPU-first deltas:
+- a ``bf16`` block is first-class and is the preferred precision (no loss
+  scaling required); ``fp16`` is kept for config-compat and engages the
+  dynamic loss scaler.
+- a ``mesh`` block declares named parallel axes (data/model/pipe/sequence/
+  expert) — the reference delegated TP shape to an external Megatron ``mpu``.
+"""
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Union
+
+from deepspeed_tpu.config import constants as C
+from deepspeed_tpu.runtime.zero.config import ZeroConfig
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _get(d: Dict[str, Any], key: str, default: Any) -> Any:
+    v = d.get(key, default)
+    return default if v is None else v
+
+
+@dataclass
+class FP16Config:
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = C.FP16_INITIAL_SCALE_POWER_DEFAULT
+    loss_scale_window: int = C.FP16_LOSS_SCALE_WINDOW_DEFAULT
+    hysteresis: int = C.FP16_HYSTERESIS_DEFAULT
+    min_loss_scale: float = C.FP16_MIN_LOSS_SCALE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FP16Config":
+        d = d or {}
+        return cls(
+            enabled=bool(_get(d, C.FP16_ENABLED, False)),
+            loss_scale=float(_get(d, C.FP16_LOSS_SCALE, 0.0)),
+            initial_scale_power=int(_get(d, C.FP16_INITIAL_SCALE_POWER,
+                                         C.FP16_INITIAL_SCALE_POWER_DEFAULT)),
+            loss_scale_window=int(_get(d, C.FP16_LOSS_SCALE_WINDOW,
+                                       C.FP16_LOSS_SCALE_WINDOW_DEFAULT)),
+            hysteresis=int(_get(d, C.FP16_HYSTERESIS, C.FP16_HYSTERESIS_DEFAULT)),
+            min_loss_scale=float(_get(d, C.FP16_MIN_LOSS_SCALE,
+                                      C.FP16_MIN_LOSS_SCALE_DEFAULT)),
+        )
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    cpu_checkpointing: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ActivationCheckpointingConfig":
+        d = d or {}
+        return cls(
+            partition_activations=bool(_get(d, C.ACT_CHKPT_PARTITION_ACTIVATIONS, False)),
+            contiguous_memory_optimization=bool(
+                _get(d, C.ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION, False)),
+            number_checkpoints=d.get(C.ACT_CHKPT_NUMBER_CHECKPOINTS),
+            synchronize_checkpoint_boundary=bool(
+                _get(d, C.ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY, False)),
+            profile=bool(_get(d, C.ACT_CHKPT_PROFILE, False)),
+            cpu_checkpointing=bool(_get(d, C.ACT_CHKPT_CPU_CHECKPOINTING, False)),
+        )
+
+
+@dataclass
+class FlopsProfilerConfig:
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FlopsProfilerConfig":
+        d = d or {}
+        return cls(
+            enabled=bool(_get(d, C.FLOPS_PROFILER_ENABLED, False)),
+            profile_step=int(_get(d, C.FLOPS_PROFILER_PROFILE_STEP, 1)),
+            module_depth=int(_get(d, C.FLOPS_PROFILER_MODULE_DEPTH, -1)),
+            top_modules=int(_get(d, C.FLOPS_PROFILER_TOP_MODULES, 1)),
+            detailed=bool(_get(d, C.FLOPS_PROFILER_DETAILED, True)),
+            output_file=d.get(C.FLOPS_PROFILER_OUTPUT_FILE),
+        )
+
+
+@dataclass
+class PLDConfig:
+    enabled: bool = False
+    theta: float = 1.0
+    gamma: float = 0.001
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "PLDConfig":
+        d = d or {}
+        return cls(enabled=bool(_get(d, C.PLD_ENABLED, False)),
+                   theta=float(_get(d, C.PLD_THETA, 1.0)),
+                   gamma=float(_get(d, C.PLD_GAMMA, 0.001)))
+
+
+@dataclass
+class MeshConfig:
+    """Named parallel axes. Sizes of 1 mean the axis is unused.
+
+    ``data`` may be -1 (infer: world_size // product(other axes)).
+    """
+
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    sequence: int = 1
+    expert: int = 1
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "MeshConfig":
+        d = d or {}
+        cfg = cls(
+            data=int(_get(d, C.MESH_DATA, -1)),
+            model=int(_get(d, C.MESH_MODEL, 1)),
+            pipe=int(_get(d, C.MESH_PIPE, 1)),
+            sequence=int(_get(d, C.MESH_SEQUENCE, 1)),
+            expert=int(_get(d, C.MESH_EXPERT, 1)),
+        )
+        for name in ("model", "pipe", "sequence", "expert"):
+            if getattr(cfg, name) < 1:
+                raise ConfigError(f"mesh.{name} must be >= 1")
+        return cfg
+
+    def resolve_data(self, world_size: int) -> int:
+        fixed = self.model * self.pipe * self.sequence * self.expert
+        if world_size % fixed != 0:
+            raise ConfigError(
+                f"world size {world_size} not divisible by mesh axes product {fixed}")
+        data = world_size // fixed
+        if self.data not in (-1, data):
+            raise ConfigError(
+                f"mesh.data={self.data} inconsistent with world={world_size}, "
+                f"model×pipe×sequence×expert={fixed}")
+        return data
+
+
+@dataclass
+class AIOConfig:
+    block_size: int = C.AIO_BLOCK_SIZE_DEFAULT
+    queue_depth: int = C.AIO_QUEUE_DEPTH_DEFAULT
+    thread_count: int = C.AIO_THREAD_COUNT_DEFAULT
+    single_submit: bool = C.AIO_SINGLE_SUBMIT_DEFAULT
+    overlap_events: bool = C.AIO_OVERLAP_EVENTS_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "AIOConfig":
+        d = d or {}
+        return cls(
+            block_size=int(_get(d, C.AIO_BLOCK_SIZE, C.AIO_BLOCK_SIZE_DEFAULT)),
+            queue_depth=int(_get(d, C.AIO_QUEUE_DEPTH, C.AIO_QUEUE_DEPTH_DEFAULT)),
+            thread_count=int(_get(d, C.AIO_THREAD_COUNT, C.AIO_THREAD_COUNT_DEFAULT)),
+            single_submit=bool(_get(d, C.AIO_SINGLE_SUBMIT, C.AIO_SINGLE_SUBMIT_DEFAULT)),
+            overlap_events=bool(_get(d, C.AIO_OVERLAP_EVENTS, C.AIO_OVERLAP_EVENTS_DEFAULT)),
+        )
+
+
+@dataclass
+class TensorboardConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TensorboardConfig":
+        d = d or {}
+        return cls(enabled=bool(_get(d, C.TENSORBOARD_ENABLED, False)),
+                   output_path=str(_get(d, C.TENSORBOARD_OUTPUT_PATH, "")),
+                   job_name=str(_get(d, C.TENSORBOARD_JOB_NAME, "DeepSpeedTPUJob")))
+
+
+class DeepSpeedTPUConfig:
+    """Parsed, validated, fully-resolved training configuration."""
+
+    def __init__(self,
+                 config: Union[str, Dict[str, Any], None],
+                 world_size: Optional[int] = None):
+        if config is None:
+            config = {}
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise ConfigError(f"config file not found: {config}")
+            with open(config, "r") as f:
+                self._param_dict = json.load(f)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        else:
+            raise ConfigError(f"config must be a path or dict, got {type(config)}")
+
+        d = self._param_dict
+        self.world_size = int(world_size) if world_size is not None else self._default_world()
+
+        # --- mesh / parallel shape -------------------------------------------------
+        self.mesh = MeshConfig.from_dict(d.get(C.MESH))
+        self.data_parallel_size = self.mesh.resolve_data(self.world_size)
+
+        # --- batch triple ----------------------------------------------------------
+        micro = d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                      d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_CHIP))
+        self.train_batch_size, self.train_micro_batch_size_per_gpu, \
+            self.gradient_accumulation_steps = self._resolve_batch_triple(
+                d.get(C.TRAIN_BATCH_SIZE), micro,
+                d.get(C.GRADIENT_ACCUMULATION_STEPS), self.data_parallel_size)
+
+        # --- optimizer / scheduler -------------------------------------------------
+        opt = d.get(C.OPTIMIZER)
+        self.optimizer_name: Optional[str] = None
+        self.optimizer_params: Dict[str, Any] = {}
+        if opt is not None:
+            if C.OPTIMIZER_TYPE not in opt:
+                raise ConfigError("optimizer block requires a 'type'")
+            self.optimizer_name = str(opt[C.OPTIMIZER_TYPE]).lower()
+            self.optimizer_params = dict(opt.get(C.OPTIMIZER_PARAMS, {}))
+        self.optimizer_legacy_fusion = bool(d.get("legacy_fusion", False))
+
+        sched = d.get(C.SCHEDULER)
+        self.scheduler_name: Optional[str] = None
+        self.scheduler_params: Dict[str, Any] = {}
+        if sched is not None:
+            if C.SCHEDULER_TYPE not in sched:
+                raise ConfigError("scheduler block requires a 'type'")
+            self.scheduler_name = str(sched[C.SCHEDULER_TYPE])
+            self.scheduler_params = dict(sched.get(C.SCHEDULER_PARAMS, {}))
+
+        # --- precision -------------------------------------------------------------
+        self.fp16 = FP16Config.from_dict(d.get(C.FP16))
+        bf16_block = d.get(C.BF16, d.get(C.BFLOAT16))
+        self.bf16_enabled = bool(_get(bf16_block or {}, C.BF16_ENABLED, False))
+        if self.fp16.enabled and self.bf16_enabled:
+            raise ConfigError("fp16 and bf16 cannot both be enabled")
+        self.amp_enabled = bool(_get(d.get(C.AMP) or {}, C.AMP_ENABLED, False))
+        self.gradient_clipping = float(_get(d, C.GRADIENT_CLIPPING,
+                                            C.GRADIENT_CLIPPING_DEFAULT))
+        self.prescale_gradients = bool(_get(d, C.PRESCALE_GRADIENTS,
+                                            C.PRESCALE_GRADIENTS_DEFAULT))
+        self.gradient_predivide_factor = float(_get(d, C.GRADIENT_PREDIVIDE_FACTOR,
+                                                    C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT))
+        self.communication_data_type = d.get(C.COMMUNICATION_DATA_TYPE)
+
+        # --- subsystem blocks ------------------------------------------------------
+        self.zero_config = ZeroConfig.from_dict(d.get(C.ZERO_OPTIMIZATION))
+        self.zero_enabled = self.zero_config.enabled
+        self.activation_checkpointing = ActivationCheckpointingConfig.from_dict(
+            d.get(C.ACTIVATION_CHECKPOINTING))
+        self.flops_profiler = FlopsProfilerConfig.from_dict(d.get(C.FLOPS_PROFILER))
+        self.pld = PLDConfig.from_dict(d.get(C.PROGRESSIVE_LAYER_DROP))
+        self.aio = AIOConfig.from_dict(d.get(C.AIO))
+        self.tensorboard = TensorboardConfig.from_dict(d.get(C.TENSORBOARD))
+        self.sparse_attention = d.get(C.SPARSE_ATTENTION)
+        self.pipeline = dict(d.get(C.PIPELINE, {}))
+        self.eigenvalue = dict(d.get(C.EIGENVALUE, {}))
+        self.quantize_training = dict(d.get(C.QUANTIZE_TRAINING, {}))
+        self.elasticity = dict(d.get(C.ELASTICITY, {}))
+
+        # --- misc ------------------------------------------------------------------
+        self.steps_per_print = int(_get(d, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT))
+        self.wall_clock_breakdown = bool(_get(d, C.WALL_CLOCK_BREAKDOWN,
+                                              C.WALL_CLOCK_BREAKDOWN_DEFAULT))
+        self.memory_breakdown = bool(_get(d, C.MEMORY_BREAKDOWN,
+                                          C.MEMORY_BREAKDOWN_DEFAULT))
+        self.dump_state = bool(_get(d, C.DUMP_STATE, C.DUMP_STATE_DEFAULT))
+        self.sparse_gradients_enabled = bool(_get(d, C.SPARSE_GRADIENTS,
+                                                  C.SPARSE_GRADIENTS_DEFAULT))
+
+        self._validate()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _default_world() -> int:
+        try:
+            import jax
+
+            return jax.device_count()
+        except Exception:
+            return 1
+
+    @staticmethod
+    def _resolve_batch_triple(train: Optional[int], micro: Optional[int],
+                              gas: Optional[int], dp: int):
+        """Solve/validate train = micro × gas × dp (reference config.py:822-893)."""
+        if train is not None:
+            train = int(train)
+        if micro is not None:
+            micro = int(micro)
+        if gas is not None:
+            gas = int(gas)
+
+        if all(v is not None for v in (train, micro, gas)):
+            if train != micro * gas * dp:
+                raise ConfigError(
+                    f"batch sizes inconsistent: train_batch_size={train} != "
+                    f"micro({micro}) × gas({gas}) × dp({dp})")
+        elif train is not None and micro is not None:
+            if train % (micro * dp) != 0:
+                raise ConfigError(
+                    f"train_batch_size {train} not divisible by micro×dp={micro * dp}")
+            gas = train // (micro * dp)
+        elif train is not None and gas is not None:
+            if train % (gas * dp) != 0:
+                raise ConfigError(
+                    f"train_batch_size {train} not divisible by gas×dp={gas * dp}")
+            micro = train // (gas * dp)
+        elif micro is not None:
+            gas = gas or 1
+            train = micro * gas * dp
+        elif train is not None:
+            gas = 1
+            if train % dp != 0:
+                raise ConfigError(f"train_batch_size {train} not divisible by dp={dp}")
+            micro = train // dp
+        else:
+            raise ConfigError(
+                "at least one of train_batch_size / train_micro_batch_size_per_gpu "
+                "must be specified")
+        for name, v in (("train_batch_size", train),
+                        ("train_micro_batch_size_per_gpu", micro),
+                        ("gradient_accumulation_steps", gas)):
+            if v <= 0:
+                raise ConfigError(f"{name} must be positive, got {v}")
+        return train, micro, gas
+
+    def _validate(self) -> None:
+        if self.zero_config.stage >= 2 and self.pipeline.get("stages", self.mesh.pipe) > 1 \
+                and self.mesh.pipe > 1:
+            raise ConfigError("ZeRO stage >= 2 is incompatible with pipeline parallelism; "
+                              "use stage 1 (reference pipe/engine.py:56)")
+        if self.fp16.enabled and self.amp_enabled:
+            raise ConfigError("fp16 and amp cannot both be enabled")
+
+    # convenience accessors mirroring the reference's getters ------------------
+    @property
+    def precision_dtype(self) -> str:
+        if self.bf16_enabled:
+            return "bfloat16"
+        if self.fp16.enabled:
+            return "float16"
+        return "float32"
+
+    @property
+    def loss_scale(self) -> float:
+        return self.fp16.loss_scale if self.fp16.enabled else 1.0
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.fp16.enabled and self.fp16.dynamic_loss_scale
+
+    def print_config(self) -> None:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.info("DeepSpeedTPUConfig:")
+        logger.info(json.dumps(self._param_dict, indent=2, sort_keys=True, default=str))

@@ -1,0 +1,52 @@
+"""Model adapters.
+
+The engine consumes a pure ``loss_fn(params, batch, rng) -> loss`` (or
+``(loss, aux)``). These adapters build one from common model styles, playing
+the role of the reference's ``nn.Module`` wrapping (engine holds the module
+and calls ``self.module(*inputs)``, engine.py:1102).
+"""
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+
+def flax_module_loss_fn(module, params: Any = None,
+                        example_batch: Any = None,
+                        init_rng: Optional[jax.Array] = None,
+                        loss_key: str = "loss") -> Tuple[Callable, Any]:
+    """Adapt a flax.linen module whose __call__ returns the scalar loss (or a
+    dict containing ``loss``). Returns (loss_fn, params).
+
+    The module is applied as ``module.apply({'params': p}, batch,
+    rngs={'dropout': rng})``; batches are passed through unchanged.
+    """
+    if params is None:
+        if example_batch is None:
+            raise ValueError("need params or example_batch to initialise the module")
+        rng = init_rng if init_rng is not None else jax.random.PRNGKey(0)
+        variables = module.init({"params": rng, "dropout": rng}, example_batch)
+        params = variables["params"]
+
+    def loss_fn(p, batch, rng):
+        out = module.apply({"params": p}, batch, rngs={"dropout": rng})
+        if isinstance(out, dict):
+            loss = out[loss_key]
+            aux = {k: v for k, v in out.items() if k != loss_key}
+            return loss, aux
+        return out
+
+    return loss_fn, params
+
+
+def supervised_loss_fn(apply_fn: Callable, loss: Callable,
+                       inputs_key: Any = 0, labels_key: Any = 1) -> Callable:
+    """Build a loss_fn from separate forward + criterion, for (x, y) batches."""
+
+    def loss_fn(p, batch, rng):
+        x = batch[inputs_key]
+        y = batch[labels_key]
+        logits = apply_fn(p, x, rng)
+        return loss(logits, y)
+
+    return loss_fn

@@ -1,0 +1,216 @@
+"""Process/axis topology.
+
+Capability parity with the reference's ``deepspeed/runtime/pipe/topology.py``
+(``ProcessTopology`` :12, ``PipeDataParallelTopology`` :235,
+``PipeModelDataParallelTopology`` :246, ``PipelineParallelGrid`` :252).
+
+On TPU the cartesian rank grid *is* the ``jax.sharding.Mesh``; this module
+keeps the pure-Python coordinate algebra (axis naming, rank<->coord mapping,
+filtered rank groups) because the pipeline scheduler, checkpoint resharding,
+and grid bookkeeping all consume it, and it must work without devices present
+(e.g. offline checkpoint tools).
+"""
+
+from collections import namedtuple
+from itertools import product
+from typing import Dict, List, Sequence, Tuple
+
+
+class ProcessTopology:
+    """An N-dimensional cartesian grid of ranks with named axes.
+
+    Axes are ordered major..minor: the *last* axis varies fastest with rank,
+    matching the reference's axes order semantics (topology.py:25-40).
+    """
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        if len(axes) != len(dims):
+            raise ValueError("axes and dims must have equal length")
+        if len(set(axes)) != len(axes):
+            raise ValueError(f"duplicate axis names: {axes}")
+        self.axes = list(axes)
+        self.dims = list(int(d) for d in dims)
+        for a, d in zip(self.axes, self.dims):
+            if d < 1:
+                raise ValueError(f"axis {a} must have dim >= 1, got {d}")
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+        self.mapping: Dict[Tuple[int, ...], int] = {}
+        ranges = [range(d) for d in self.dims]
+        for global_rank, coord in enumerate(product(*ranges)):
+            key = self.ProcessCoord(*coord)
+            self.mapping[key] = global_rank
+
+    def get_rank(self, **coord_kwargs) -> int:
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() requires all axes {self.axes}")
+        key = self.ProcessCoord(**coord_kwargs)
+        if key not in self.mapping:
+            raise ValueError(f"coord {coord_kwargs} out of range for dims {self.dims}")
+        return self.mapping[key]
+
+    def get_axis_names(self) -> List[str]:
+        return list(self.axes)
+
+    def get_rank_repr(self, rank: int, omit_axes: Sequence[str] = ("data",),
+                      inner_sep: str = "_", outer_sep: str = "-") -> str:
+        """String like 'pipe_00-model_00' used in checkpoint filenames."""
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis: str) -> int:
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank: int):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Rank lists for communication along ``axis``, one per fixed setting
+        of the other axes (reference topology.py:139)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for combo in product(*ranges):
+            fixed = dict(zip(other_axes, combo))
+            ranks = [self.get_rank(**{axis: i, **fixed}) for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        """All ranks whose coords match the given axis=value filters."""
+
+        def _match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+
+        return sorted(rank for coord, rank in self.mapping.items() if _match(coord))
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self) -> int:
+        size = 1
+        for d in self.dims:
+            size *= d
+        return size
+
+    def __str__(self) -> str:
+        return f"ProcessTopology(axes={self.axes}, dims={self.dims})"
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Pipeline × data hybrid; data-parallel groups span adjacent ranks so the
+    heavy DP gradient traffic stays on the fastest links (topology.py:235)."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3D pipe × data × model topology (topology.py:246)."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Axis-group bookkeeping for a pipeline run (reference topology.py:252).
+
+    The reference builds torch process groups here; on TPU the collectives are
+    mesh-axis-addressed inside jit, so this grid only answers the pure
+    rank-arithmetic questions (stage ids, peer stage ranks, group membership)
+    that the pipeline module/engine and checkpoint code ask.
+    """
+
+    def __init__(self, topology: ProcessTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.world_size = topology.world_size()
+
+        self.data_parallel_size = max(self._topo.get_dim("data"), 1)
+        self.pipe_parallel_size = max(self._topo.get_dim("pipe"), 1)
+        self.model_parallel_size = max(self._topo.get_dim("model"), 1)
+        assert self.world_size == (
+            self.data_parallel_size * self.pipe_parallel_size * self.model_parallel_size)
+
+        coord = self._topo.get_coord(self.global_rank)
+        self.stage_id = getattr(coord, "pipe", 0)
+        self.data_parallel_id = getattr(coord, "data", 0)
+        self.model_parallel_id = getattr(coord, "model", 0) if "model" in self._topo.axes else 0
+        # "slice parallel" is the reference's alias for the model axis
+        # (topology.py:446-455).
+        self.slice_parallel_id = self.model_parallel_id
+
+        self.pp_group = self._topo.filter_match(data=self.data_parallel_id) \
+            if "data" in self._topo.axes else list(range(self.world_size))
+        self.dp_group = self._topo.filter_match(pipe=self.stage_id) \
+            if "pipe" in self._topo.axes else list(range(self.world_size))
+
+        self.p2p_matrix = self._build_p2p_pairs()
+
+    def _build_p2p_pairs(self) -> List[Tuple[int, int]]:
+        """Adjacent-stage (send, recv) rank pairs incl. the wraparound pair used
+        for tied-embedding grads (reference topology.py:373-389)."""
+        pairs = []
+        if "pipe" not in self._topo.axes:
+            return pairs
+        for lists in self._topo.get_axis_comm_lists("pipe"):
+            for i, rank in enumerate(lists):
+                nxt = lists[(i + 1) % len(lists)]
+                pairs.append((rank, nxt))
+        return pairs
+
+    # --- stage arithmetic ------------------------------------------------
+    def get_stage_id(self) -> int:
+        return self.stage_id
+
+    def get_data_parallel_id(self) -> int:
+        return self.data_parallel_id
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self.stage_id
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.pipe_parallel_size
+
+    def get_data_parallel_rank(self) -> int:
+        return self.data_parallel_id
+
+    def get_data_parallel_world_size(self) -> int:
+        return self.data_parallel_size
+
+    def get_model_parallel_rank(self) -> int:
+        return self.model_parallel_id
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.model_parallel_size
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    def stage_to_global(self, stage_id: int, **kwargs) -> int:
+        coord = self._topo.get_coord(self.global_rank)
+        d = coord._asdict()
+        d.update(kwargs)
+        d["pipe"] = stage_id
+        return self._topo.get_rank(**d)
+
+    @property
+    def topology(self) -> ProcessTopology:
+        return self._topo

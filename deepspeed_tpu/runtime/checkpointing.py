@@ -1,0 +1,183 @@
+"""Checkpoint save/load.
+
+Parity with the reference's checkpoint subsystem (``engine.save_checkpoint``
+``runtime/engine.py:1838``, ``load_checkpoint`` :1638, SURVEY.md §3.5):
+
+- tag-named directories under ``save_dir`` with a ``latest`` pointer file;
+- model states and optimizer/ZeRO states are logically separate so a model
+  can be loaded without optimizer state (``load_optimizer_states=False``);
+- ZeRO-sharded state is saved *distributed* via orbax (each host writes its
+  shards — the analogue of per-dp-rank ``zero_pp_rank_*`` files) and can be
+  restored onto a different dp world size: orbax re-shards on load, which is
+  the reference's ``elastic_checkpoint`` dp-resharding (stage2.py:1921);
+- ``consolidate_to_fp32`` mirrors ``zero_to_fp32.py`` (offline shard merge).
+
+client_state round-trips arbitrary user metadata exactly like the reference.
+"""
+
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+LATEST_FILE = "latest"
+STATE_SUBDIR = "state"
+META_FILE = "ds_meta.json"
+CLIENT_STATE_FILE = "client_state.pkl"
+SCHED_FILE = "lr_scheduler.json"
+
+
+def _tag_dir(save_dir: str, tag: str) -> str:
+    return os.path.join(save_dir, str(tag))
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[Dict] = None,
+                    save_latest: bool = True) -> str:
+    """Write a checkpoint; returns the tag directory path."""
+    if tag is None:
+        tag = f"global_step{engine.global_steps}"
+    path = _tag_dir(save_dir, tag)
+    os.makedirs(path, exist_ok=True)
+
+    state = engine.state
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(os.path.join(path, STATE_SUBDIR)),
+               _to_saveable(state), force=True)
+    ckptr.wait_until_finished()
+
+    if jax.process_index() == 0:
+        meta = {
+            "global_steps": engine.global_steps,
+            "micro_steps": engine.micro_steps,
+            "skipped_steps": int(state.skipped_steps),
+            "zero_stage": engine.config.zero_config.stage,
+            "precision": engine.precision.name,
+            "dp_world_size": engine.dp_size,
+            "world_size": engine.mesh.size,
+            "gradient_accumulation_steps": engine.gradient_accumulation_steps,
+            "ds_version": _version(),
+        }
+        with open(os.path.join(path, META_FILE), "w") as f:
+            json.dump(meta, f, indent=2)
+        with open(os.path.join(path, CLIENT_STATE_FILE), "wb") as f:
+            pickle.dump(client_state or {}, f)
+        if engine.lr_scheduler is not None:
+            with open(os.path.join(path, SCHED_FILE), "w") as f:
+                json.dump(engine.lr_scheduler.state_dict(), f)
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+    log_dist(f"saved checkpoint {path}", ranks=[0])
+    return path
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True,
+                    load_lr_scheduler_states: bool = True):
+    """Restore engine state; returns (path, client_state) like the reference."""
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.exists(latest):
+            logger.warning(f"no 'latest' file in {load_dir}; nothing restored")
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = _tag_dir(load_dir, tag)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"checkpoint dir not found: {path}")
+
+    abstract_state = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        engine.state)
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(os.path.abspath(os.path.join(path, STATE_SUBDIR)),
+                             _to_saveable(abstract_state))
+    new_state = _from_saveable(engine.state, restored)
+    if not load_optimizer_states:
+        new_state = new_state._replace(opt_state=engine.state.opt_state)
+    engine.state = new_state
+
+    meta_path = os.path.join(path, META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        engine.global_steps = int(meta.get("global_steps", 0))
+        engine.micro_steps = int(meta.get("micro_steps", 0))
+    client_state: Dict[str, Any] = {}
+    cs_path = os.path.join(path, CLIENT_STATE_FILE)
+    if os.path.exists(cs_path):
+        with open(cs_path, "rb") as f:
+            client_state = pickle.load(f)
+    if load_lr_scheduler_states and engine.lr_scheduler is not None:
+        sp = os.path.join(path, SCHED_FILE)
+        if os.path.exists(sp):
+            with open(sp) as f:
+                engine.lr_scheduler.load_state_dict(json.load(f))
+    log_dist(f"loaded checkpoint {path}", ranks=[0])
+    return path, client_state
+
+
+def _to_saveable(state):
+    """TrainState (NamedTuple of pytrees) -> plain nested dict for orbax.
+
+    Works equally on a tree of arrays or of ShapeDtypeStructs (restore types).
+    """
+    d = state._asdict() if hasattr(state, "_asdict") else dict(state)
+    for k, v in d.items():
+        if hasattr(v, "_asdict"):
+            d[k] = _to_saveable(v)
+    return d
+
+
+def _from_saveable(template_state, restored: Dict):
+    """Plain nested dict -> the template's NamedTuple types."""
+
+    def rebuild(template, node):
+        if hasattr(template, "_fields"):
+            return type(template)(**{f: rebuild(getattr(template, f), node[f])
+                                     for f in template._fields})
+        return node
+
+    return rebuild(template_state, restored)
+
+
+def _version() -> str:
+    from deepspeed_tpu.version import __version__
+
+    return __version__
+
+
+# ---------------------------------------------------------------------------
+# zero_to_fp32 equivalent (reference utils/zero_to_fp32.py)
+# ---------------------------------------------------------------------------
+
+def consolidate_to_fp32(checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Offline: read a (possibly sharded) checkpoint and return a flat dict of
+    consolidated fp32 master params, without constructing an engine. orbax
+    reassembles shards transparently, which is the whole job of the
+    reference's zero_to_fp32.py script."""
+    if tag is None:
+        with open(os.path.join(checkpoint_dir, LATEST_FILE)) as f:
+            tag = f.read().strip()
+    path = os.path.abspath(os.path.join(_tag_dir(checkpoint_dir, tag), STATE_SUBDIR))
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(path)
+    params = restored["params"]
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else str(k), v)
+        else:
+            flat[prefix] = np.asarray(node, dtype=np.float32)
+
+    walk("", params)
+    return flat

@@ -1,0 +1,131 @@
+"""Data pipeline.
+
+Parity with the reference ``deepspeed/runtime/dataloader.py``:
+``DeepSpeedDataLoader`` (:33) wraps the user dataset with an automatic
+distributed sampler sized by the data-parallel world, and ``RepeatingLoader``
+(:10) provides the infinite iterator the pipeline engine consumes.
+
+TPU-first: batches are numpy pytrees (host-side), sharded onto the mesh by
+``engine.put_batch``. One *process* per host feeds all its addressable chips,
+so the sampler granularity is (process_index, process_count) — each process
+draws the micro-batches for every data-parallel position it hosts.
+"""
+
+import math
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class DistributedSampler:
+    """Deterministic rank-strided sampler (torch DistributedSampler semantics:
+    pad to a multiple of world, stride by rank, reshuffle per epoch)."""
+
+    def __init__(self, dataset_len: int, num_replicas: int, rank: int,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = False):
+        if rank >= num_replicas or rank < 0:
+            raise ValueError(f"invalid rank {rank} for world {num_replicas}")
+        self.dataset_len = dataset_len
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        if drop_last:
+            self.num_samples = dataset_len // num_replicas
+        else:
+            self.num_samples = math.ceil(dataset_len / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[int]:
+        if self.shuffle:
+            g = np.random.default_rng(self.seed + self.epoch)
+            indices = g.permutation(self.dataset_len).tolist()
+        else:
+            indices = list(range(self.dataset_len))
+        if not self.drop_last:
+            pad = self.total_size - len(indices)
+            indices += indices[:pad]
+        else:
+            indices = indices[:self.total_size]
+        return iter(indices[self.rank:self.total_size:self.num_replicas])
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+
+def default_collate(samples: Sequence[Any]):
+    """Stack a list of sample pytrees into one batch pytree of numpy arrays."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Batch iterator over an indexable dataset with DP-aware sampling."""
+
+    def __init__(self,
+                 dataset,
+                 batch_size: int,
+                 data_parallel_world_size: int = 1,
+                 data_parallel_rank: int = 0,
+                 collate_fn: Optional[Callable] = None,
+                 shuffle: bool = True,
+                 seed: int = 0,
+                 drop_last: bool = True,
+                 data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.collate_fn = collate_fn or default_collate
+        if data_sampler is None:
+            data_sampler = DistributedSampler(
+                len(dataset), num_replicas=data_parallel_world_size,
+                rank=data_parallel_rank, shuffle=shuffle, seed=seed,
+                drop_last=drop_last)
+        self.sampler = data_sampler
+        self.drop_last = drop_last
+        self.len = len(self.sampler) // self.batch_size if drop_last else \
+            math.ceil(len(self.sampler) / self.batch_size)
+
+    def __len__(self) -> int:
+        return self.len
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(self.dataset[idx])
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference :10)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+        self.epoch = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.epoch += 1
+            if hasattr(self.loader, "sampler") and hasattr(self.loader.sampler, "set_epoch"):
+                self.loader.sampler.set_epoch(self.epoch)
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

@@ -1,0 +1,490 @@
+"""The training engine.
+
+TPU-native re-design of the reference ``DeepSpeedEngine``
+(``deepspeed/runtime/engine.py:85``). The torch engine is a stateful
+``nn.Module`` wrapper whose ``forward/backward/step`` mutate flat fp16
+buffers via autograd hooks; here the same public surface drives three jitted
+pure functions over an explicit ``TrainState`` pytree:
+
+- ``_micro_step``  — fwd+bwd of one micro-batch, grads accumulated into a
+  (possibly data-sharded) fp32 buffer. Equivalent to engine.forward
+  (:1073) + engine.backward (:1144): loss is scaled by the dynamic loss
+  scale and divided by gradient_accumulation_steps (engine.py:1158).
+- ``_apply_step``  — GAS-boundary optimizer step: overflow check (≡
+  CheckOverflow, runtime/utils.py:74), unscale, global-norm clip, Adam/LAMB
+  update, loss-scale update, overflow-skip (≡ _take_model_step :1253).
+- ``_train_step``  — fused scan over all GAS micro-batches + apply, used by
+  ``train_batch`` and the benchmark path (single dispatch per global step).
+
+ZeRO stages are *placement policies* (runtime/zero/partition.py): the same
+jitted functions run stages 0-3; only the in/out shardings change, and XLA
+emits allreduce / reduce-scatter / all-gather accordingly. Gradient
+accumulation therefore happens on the *sharded* grads for stage>=2 — each
+device accumulates only its shard, the memory/comm behaviour the reference
+builds by hand with IPG buckets (stage2.py:701).
+
+The "model" is a pure ``loss_fn(params, batch, rng) -> loss | (loss, aux)``;
+adapters for flax modules live in ``deepspeed_tpu.models.adapter``.
+"""
+
+import collections
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+from deepspeed_tpu.config import constants as C
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, FusedAdamW, HostOffloadAdam
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+from deepspeed_tpu.parallel.mesh import DATA_AXIS, build_mesh
+from deepspeed_tpu.runtime.lr_schedules import build_lr_schedule
+from deepspeed_tpu.runtime.precision import (LossScaleState, PrecisionPolicy,
+                                             make_loss_scaler)
+from deepspeed_tpu.runtime.utils import (clip_grad_by_global_norm, global_norm,
+                                         has_inf_or_nan)
+from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+
+class TrainState(NamedTuple):
+    """Everything that evolves during training — one sharded pytree."""
+
+    step: jax.Array            # global (optimizer) steps taken, int32
+    micro_step: jax.Array      # micro-batches seen, int32
+    params: Any                # fp32 master params (ZeRO-sharded per stage)
+    opt_state: Any             # optimizer moments (ZeRO-sharded stage>=1)
+    grad_acc: Any              # fp32 grad accumulator (sharded stage>=2)
+    loss_scale: LossScaleState
+    skipped_steps: jax.Array   # int32, overflow-skipped steps
+    rng: jax.Array             # PRNG key threaded through dropout
+
+
+class SGD:
+    """Plain SGD with momentum — keeps the basic-optimizer path complete."""
+
+    def __init__(self, lr: float = 1e-3, momentum: float = 0.0,
+                 weight_decay: float = 0.0):
+        self.lr, self.momentum, self.weight_decay = float(lr), float(momentum), float(weight_decay)
+
+    def init(self, params):
+        if self.momentum == 0.0:
+            return jnp.zeros((), jnp.int32)
+        return jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def leaf(p, g, m):
+            g = g.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            if self.momentum == 0.0:
+                return p - lr * g, m
+            m = self.momentum * m + g
+            return p - lr * m, m
+
+        if self.momentum == 0.0:
+            new_p = jax.tree_util.tree_map(lambda p, g: leaf(p, g, None)[0], params, grads)
+            return new_p, state
+        out = jax.tree_util.tree_map(leaf, params, grads, state)
+        new_p = jax.tree_util.tree_map(lambda o: o[0], out,
+                                       is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree_util.tree_map(lambda o: o[1], out,
+                                       is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, new_m
+
+
+OPTIMIZER_REGISTRY = {
+    C.ADAM_OPTIMIZER: FusedAdam,
+    C.ADAMW_OPTIMIZER: FusedAdamW,
+    C.LAMB_OPTIMIZER: FusedLamb,
+    C.CPU_ADAM_OPTIMIZER: HostOffloadAdam,
+    C.SGD_OPTIMIZER: SGD,
+}
+
+
+def _tree_where(pred, a, b):
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+class TPUEngine:
+    """The DeepSpeedEngine analogue.
+
+    Construction wires config → mesh → ZeRO placement → optimizer → loss
+    scaler → jitted steps, mirroring the reference's __init__ call stack
+    (SURVEY.md §3.2).
+    """
+
+    def __init__(self,
+                 loss_fn: Callable,
+                 params: Any,
+                 config: DeepSpeedTPUConfig,
+                 mesh: Optional[Mesh] = None,
+                 param_partition_specs: Any = None,
+                 optimizer: Any = None,
+                 lr_scheduler: Any = None,
+                 batch_spec: Optional[PartitionSpec] = None,
+                 rng_seed: int = 0,
+                 donate_state: bool = True):
+        self.config = config
+        self.loss_fn = loss_fn
+        self.mesh = mesh if mesh is not None else build_mesh(
+            data=-1, model=config.mesh.model, pipe=config.mesh.pipe,
+            sequence=config.mesh.sequence, expert=config.mesh.expert)
+        self.dp_size = self.mesh.shape.get(DATA_AXIS, 1)
+
+        # --- precision ------------------------------------------------------
+        self.precision = PrecisionPolicy(config.precision_dtype)
+        self.loss_scaler = make_loss_scaler(
+            fp16_enabled=config.fp16.enabled,
+            dynamic=config.fp16.dynamic_loss_scale,
+            static_scale=config.fp16.loss_scale or 1.0,
+            initial_scale_power=config.fp16.initial_scale_power,
+            scale_window=config.fp16.loss_scale_window,
+            min_scale=config.fp16.min_loss_scale,
+            hysteresis=config.fp16.hysteresis)
+
+        # --- ZeRO placement -------------------------------------------------
+        self.partitioner = ZeroPartitioner(self.mesh, config.zero_config)
+        self._base_specs = param_partition_specs
+        self.param_specs = self.partitioner.param_specs(params, param_partition_specs)
+        self.grad_specs = self.partitioner.grad_specs(params, param_partition_specs)
+        self.opt_specs = self.partitioner.opt_state_specs(params, param_partition_specs)
+        self.batch_spec = batch_spec if batch_spec is not None else PartitionSpec(DATA_AXIS)
+
+        # --- optimizer ------------------------------------------------------
+        self.optimizer = optimizer if optimizer is not None \
+            else self._configure_basic_optimizer()
+        self.lr_scheduler = lr_scheduler if lr_scheduler is not None \
+            else build_lr_schedule(config.scheduler_name, config.scheduler_params)
+        self._base_lr = getattr(self.optimizer, "lr", 1e-3)
+
+        # --- initial state placement ---------------------------------------
+        self.state = self._init_state(params, rng_seed)
+
+        # --- jitted step functions -----------------------------------------
+        self._donate = donate_state
+        self._build_step_fns()
+
+        # --- bookkeeping ----------------------------------------------------
+        self.gradient_accumulation_steps = config.gradient_accumulation_steps
+        self.train_micro_batch_size_per_gpu = config.train_micro_batch_size_per_gpu
+        self.train_batch_size = config.train_batch_size
+        self.steps_per_print = config.steps_per_print
+        self.wall_clock_breakdown = config.wall_clock_breakdown
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size,
+            steps_per_output=self.steps_per_print)
+        self._micro_in_window = 0
+        self._last_loss = None
+        self.global_steps = 0
+        self.micro_steps = 0
+        self.losses = collections.deque(maxlen=100)
+
+        log_dist(
+            f"TPUEngine initialised: zero_stage={config.zero_config.stage} "
+            f"precision={self.precision.name} dp={self.dp_size} "
+            f"mesh={dict(self.mesh.shape)} gas={self.gradient_accumulation_steps}",
+            ranks=[0])
+
+    # ------------------------------------------------------------------
+    def _configure_basic_optimizer(self):
+        """Reference _configure_basic_optimizer (engine.py:746)."""
+        name = self.config.optimizer_name or C.ADAM_OPTIMIZER
+        params = dict(self.config.optimizer_params)
+        params.pop(C.MAX_GRAD_NORM, None)  # engine owns clipping, as in reference
+        if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER):
+            from deepspeed_tpu.ops.onebit.adam import OneBitAdam
+            from deepspeed_tpu.ops.onebit.lamb import OneBitLamb
+            cls = OneBitAdam if name == C.ONEBIT_ADAM_OPTIMIZER else OneBitLamb
+            return cls(mesh=self.mesh, **params)
+        if name == C.ADAM_OPTIMIZER:
+            # reference maps adam+adam_w_mode (default true) to FusedAdam(AdamW)
+            adam_w_mode = params.pop("adam_w_mode", True)
+            torch_adam = params.pop("torch_adam", False)
+            del torch_adam
+            return FusedAdam(adamw_mode=adam_w_mode, **params)
+        if name not in OPTIMIZER_REGISTRY:
+            raise ValueError(f"unknown optimizer '{name}'")
+        return OPTIMIZER_REGISTRY[name](**params)
+
+    # ------------------------------------------------------------------
+    def _init_state(self, params: Any, rng_seed: int) -> TrainState:
+        """Place master params / moments / grad-acc with their ZeRO shardings."""
+        mesh = self.mesh
+
+        def shard_like(tree, specs):
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(
+                    jnp.asarray(x, jnp.float32), NamedSharding(mesh, s)),
+                tree, specs)
+
+        with mesh:
+            master = shard_like(params, self.param_specs)
+            opt_state_host = self.optimizer.init(master)
+            opt_specs_full = self._opt_state_specs(opt_state_host, params)
+            self.opt_state_specs_full = opt_specs_full
+            opt_state = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(jnp.asarray(x), NamedSharding(mesh, s)),
+                opt_state_host, opt_specs_full)
+            grad_acc = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(
+                    jnp.zeros(p.shape, jnp.float32), NamedSharding(mesh, s)),
+                master, self.grad_specs)
+            rep = NamedSharding(mesh, PartitionSpec())
+            return TrainState(
+                step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+                micro_step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+                params=master,
+                opt_state=opt_state,
+                grad_acc=grad_acc,
+                loss_scale=jax.device_put(self.loss_scaler.init(), rep),
+                skipped_steps=jax.device_put(jnp.zeros((), jnp.int32), rep),
+                rng=jax.device_put(jax.random.PRNGKey(rng_seed), rep))
+
+    def _opt_state_specs(self, opt_state: Any, params: Any) -> Any:
+        """Spec tree for the optimizer state: any sub-tree that mirrors the
+        param tree structure (moment trees) gets the ZeRO opt-state specs;
+        everything else (step counters etc.) is replicated."""
+        params_structure = jax.tree_util.tree_structure(params)
+
+        def specs_for(sub):
+            if jax.tree_util.tree_structure(sub) == params_structure:
+                return self.opt_specs
+            return jax.tree_util.tree_map(lambda _: PartitionSpec(), sub)
+
+        if hasattr(opt_state, "_fields"):  # NamedTuple of sub-trees
+            return type(opt_state)(*(specs_for(getattr(opt_state, f))
+                                     for f in opt_state._fields))
+        return specs_for(opt_state)
+
+    # ------------------------------------------------------------------
+    # jitted step construction
+    # ------------------------------------------------------------------
+    def _build_step_fns(self) -> None:
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        fp16 = cfg.fp16.enabled
+        clip = cfg.gradient_clipping
+        predivide = cfg.prescale_gradients
+        optimizer = self.optimizer
+        scaler = self.loss_scaler
+        precision = self.precision
+        loss_fn = self.loss_fn
+        mesh = self.mesh
+
+        grad_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self.grad_specs)
+
+        def scaled_loss_fn(compute_params, batch, rng, scale):
+            out = loss_fn(compute_params, batch, rng)
+            loss, aux = (out if isinstance(out, tuple) else (out, None))
+            loss32 = loss.astype(jnp.float32)
+            scaled = loss32 * scale / gas
+            if predivide:
+                scaled = scaled / self.dp_size * cfg.gradient_predivide_factor
+            return scaled, (loss32, aux)
+
+        def micro_step(state: TrainState, batch):
+            rng, sub = jax.random.split(state.rng)
+            compute_params = precision.cast_params(state.params)
+            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
+            grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
+            (_, (loss, aux)), grads = grad_fn(compute_params, batch, sub, scale)
+            grads = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), state.grad_acc, grads)
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+            return state._replace(micro_step=state.micro_step + 1,
+                                  grad_acc=grads, rng=rng), loss, aux
+
+        def apply_step(state: TrainState, lr):
+            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
+            inv = 1.0 / scale
+            if predivide:
+                inv = inv * self.dp_size / cfg.gradient_predivide_factor
+            grads = jax.tree_util.tree_map(lambda g: g * inv, state.grad_acc)
+            overflow = has_inf_or_nan(grads) if fp16 else jnp.zeros((), jnp.bool_)
+            norm = global_norm(grads)
+            if clip > 0.0:
+                grads = clip_grad_by_global_norm(grads, clip, norm=norm)
+            new_params, new_opt = optimizer.update(grads, state.opt_state,
+                                                   state.params, lr=lr)
+            new_params = _tree_where(overflow, state.params, new_params)
+            new_opt = _tree_where(overflow, state.opt_state, new_opt)
+            new_ls = scaler.update(state.loss_scale, overflow)
+            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
+            return state._replace(
+                step=state.step + jnp.where(overflow, 0, 1),
+                params=new_params, opt_state=new_opt, grad_acc=zero_acc,
+                loss_scale=new_ls,
+                skipped_steps=state.skipped_steps + overflow.astype(jnp.int32),
+            ), overflow, norm
+
+        def train_step(state: TrainState, batches, lr):
+            """Fused GAS loop: batches have leading dim == gas."""
+
+            def body(st, batch):
+                st, loss, _ = micro_step(st, batch)
+                return st, loss
+
+            state, losses = jax.lax.scan(body, state, batches)
+            state, overflow, norm = apply_step(state, lr)
+            return state, jnp.mean(losses), overflow, norm
+
+        def eval_step(state: TrainState, batch):
+            compute_params = precision.cast_params(state.params)
+            out = loss_fn(compute_params, batch, state.rng)
+            loss, aux = (out if isinstance(out, tuple) else (out, None))
+            return loss.astype(jnp.float32), aux
+
+        donate = (0,) if self._donate else ()
+        self._micro_step = jax.jit(micro_step, donate_argnums=donate)
+        self._apply_step = jax.jit(apply_step, donate_argnums=donate)
+        self._train_step = jax.jit(train_step, donate_argnums=donate)
+        self._eval_step = jax.jit(eval_step)
+
+    # ------------------------------------------------------------------
+    # Public API (reference parity: engine(batch) / backward / step)
+    # ------------------------------------------------------------------
+    def __call__(self, batch):
+        return self.forward(batch)
+
+    def _current_lr(self) -> jax.Array:
+        if self.lr_scheduler is not None:
+            return jnp.float32(self.lr_scheduler.lr_at(self.global_steps))
+        return jnp.float32(self._base_lr)
+
+    def put_batch(self, batch, leading_gas_dim: bool = False):
+        """Shard a host batch across the data axis. With ``leading_gas_dim``
+        the leaves carry a micro-batch dimension first (train_batch path) and
+        the data axis shards dim 1."""
+        spec = self.batch_spec
+        if leading_gas_dim:
+            spec = PartitionSpec(None, *tuple(self.batch_spec))
+        sharding = NamedSharding(self.mesh, spec)
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def put(x):
+            if isinstance(x, jax.Array) and not x.is_deleted():
+                return x  # already placed
+            x = np.asarray(x)
+            return jax.device_put(x, sharding if x.ndim >= len(tuple(spec)) and x.ndim > 0
+                                  else rep)
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def forward(self, batch):
+        """Compute loss and accumulate grads for one micro-batch."""
+        if self.wall_clock_breakdown:
+            self.timers("forward").start()
+        batch = self.put_batch(batch)
+        self.state, loss, _ = self._micro_step(self.state, batch)
+        self._last_loss = loss
+        if self.wall_clock_breakdown:
+            self.timers("forward").stop()
+        return loss
+
+    def backward(self, loss=None, allreduce_gradients: bool = True):
+        """API-parity no-op: gradients were produced in forward's value_and_grad
+        (an XLA program has no separate backward dispatch). Kept so reference
+        training loops run unchanged."""
+        self.micro_steps += 1
+        self._micro_in_window += 1
+        return loss if loss is not None else self._last_loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return self._micro_in_window >= self.gradient_accumulation_steps
+
+    def step(self):
+        """Optimizer step at GAS boundary (reference engine.step :1302)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        if self.wall_clock_breakdown:
+            self.timers("step").start()
+        lr = self._current_lr()
+        self.state, overflow, _ = self._apply_step(self.state, lr)
+        self._micro_in_window = 0
+        self.global_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self.wall_clock_breakdown:
+            self.timers("step").stop()
+        if self.global_steps % self.steps_per_print == 0:
+            loss = float(self._last_loss) if self._last_loss is not None else float("nan")
+            log_dist(f"step={self.global_steps} loss={loss:.4f} "
+                     f"lr={float(lr):.3e} loss_scale={float(self.state.loss_scale.scale):.1f}",
+                     ranks=[0])
+
+    def train_batch(self, batches) -> jax.Array:
+        """Fused full step: ``batches`` is a pytree whose leaves have leading
+        dim gradient_accumulation_steps (one entry per micro-batch)."""
+        self.tput_timer.start()
+        batches = self.put_batch(batches, leading_gas_dim=True)
+        lr = self._current_lr()
+        self.state, loss, overflow, _ = self._train_step(self.state, batches, lr)
+        self.global_steps += 1
+        self.micro_steps += self.gradient_accumulation_steps
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self.tput_timer.stop()
+        self._last_loss = loss
+        return loss
+
+    def eval_batch(self, batch):
+        batch = self.put_batch(batch)
+        loss, _ = self._eval_step(self.state, batch)
+        return loss
+
+    # ------------------------------------------------------------------
+    # Introspection / parity getters
+    # ------------------------------------------------------------------
+    @property
+    def module_params(self):
+        """Compute-precision view of the parameters."""
+        return self.precision.cast_params(self.state.params)
+
+    def get_global_grad_norm(self) -> float:
+        with self.mesh:
+            return float(jax.jit(global_norm)(self.state.grad_acc))
+
+    def zero_optimization(self) -> bool:
+        return self.config.zero_enabled
+
+    def zero_optimization_stage(self) -> int:
+        return self.config.zero_config.stage
+
+    def get_lr(self):
+        return [float(self._current_lr())]
+
+    @property
+    def skipped_steps(self) -> int:
+        return int(self.state.skipped_steps)
+
+    def loss_scale(self) -> float:
+        return float(self.state.loss_scale.scale)
+
+    # ------------------------------------------------------------------
+    # Checkpointing — delegates to runtime.checkpointing
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[Dict] = None,
+                        save_latest: bool = True) -> str:
+        from deepspeed_tpu.runtime import checkpointing as ckpt
+
+        return ckpt.save_checkpoint(self, save_dir, tag=tag,
+                                    client_state=client_state or {},
+                                    save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_optimizer_states: bool = True,
+                        load_lr_scheduler_states: bool = True):
+        from deepspeed_tpu.runtime import checkpointing as ckpt
+
+        return ckpt.load_checkpoint(self, load_dir, tag=tag,
+                                    load_optimizer_states=load_optimizer_states,
+                                    load_lr_scheduler_states=load_lr_scheduler_states)

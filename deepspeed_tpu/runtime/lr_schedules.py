@@ -1,0 +1,196 @@
+"""LR schedules.
+
+Parity with the reference ``deepspeed/runtime/lr_schedules.py``:
+``LRRangeTest`` (:301), ``OneCycle`` (:408), ``WarmupLR`` (:677),
+``WarmupDecayLR`` (:761). Each schedule is a pure ``step -> lr`` function
+(jit-safe jnp math) wrapped in a small stateless object exposing the
+reference's ``get_lr()/step()`` surface for API compatibility; the engine
+passes the scalar into the jitted train step, so LR changes never trigger
+recompilation.
+"""
+
+import math
+from typing import Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+VALID_SCHEDULES = ["LRRangeTest", "OneCycle", "WarmupLR", "WarmupDecayLR"]
+
+# Config keys (reference lr_schedules.py:24-53)
+LR_RANGE_TEST_MIN_LR = "lr_range_test_min_lr"
+LR_RANGE_TEST_STEP_SIZE = "lr_range_test_step_size"
+LR_RANGE_TEST_STEP_RATE = "lr_range_test_step_rate"
+LR_RANGE_TEST_STAIRCASE = "lr_range_test_staircase"
+WARMUP_MIN_LR = "warmup_min_lr"
+WARMUP_MAX_LR = "warmup_max_lr"
+WARMUP_NUM_STEPS = "warmup_num_steps"
+TOTAL_NUM_STEPS = "total_num_steps"
+
+
+class _Schedule:
+    """Minimal stateful wrapper: holds last_step, mirrors torch scheduler API."""
+
+    def __init__(self, fn: Callable[[jnp.ndarray], jnp.ndarray]):
+        self._fn = fn
+        self.last_step = 0
+
+    def lr_at(self, step) -> jnp.ndarray:
+        """Pure lookup — call from inside jit with a traced step."""
+        return self._fn(jnp.asarray(step, jnp.float32))
+
+    # torch-scheduler-compatible surface --------------------------------
+    def step(self, increment: int = 1) -> None:
+        self.last_step += increment
+
+    def get_lr(self) -> float:
+        return float(self._fn(jnp.float32(self.last_step)))
+
+    def get_last_lr(self):
+        return [self.get_lr()]
+
+    def state_dict(self) -> Dict:
+        return {"last_step": self.last_step}
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.last_step = int(sd["last_step"])
+
+
+class WarmupLR(_Schedule):
+    """Linear warmup from min_lr to max_lr, then constant (reference :677)."""
+
+    def __init__(self, warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+                 warmup_num_steps: int = 1000, last_batch_iteration: int = -1):
+        lo, hi, n = float(warmup_min_lr), float(warmup_max_lr), max(int(warmup_num_steps), 1)
+
+        def fn(step):
+            frac = jnp.clip(step / n, 0.0, 1.0)
+            return lo + (hi - lo) * frac
+
+        super().__init__(fn)
+        self.last_step = last_batch_iteration + 1
+
+
+class WarmupDecayLR(_Schedule):
+    """Warmup then linear decay to zero over total_num_steps (reference :761)."""
+
+    def __init__(self, total_num_steps: int, warmup_min_lr: float = 0.0,
+                 warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                 last_batch_iteration: int = -1):
+        lo, hi = float(warmup_min_lr), float(warmup_max_lr)
+        n = max(int(warmup_num_steps), 1)
+        total = max(int(total_num_steps), n + 1)
+
+        def fn(step):
+            warm = lo + (hi - lo) * jnp.clip(step / n, 0.0, 1.0)
+            decay = hi * jnp.clip((total - step) / (total - n), 0.0, 1.0)
+            return jnp.where(step < n, warm, decay)
+
+        super().__init__(fn)
+        self.last_step = last_batch_iteration + 1
+
+
+class OneCycle(_Schedule):
+    """Two-phase cycle then decay (reference :408).
+
+    Phase 1: first_step_size up from cycle_min_lr to cycle_max_lr; phase 2:
+    back down; then decay_lr_rate per post-cycle step. Momentum cycling is
+    exposed via ``momentum_at`` for optimizers that consume it.
+    """
+
+    def __init__(self, cycle_min_lr: float, cycle_max_lr: float,
+                 cycle_first_step_size: int = 2000,
+                 cycle_second_step_size: Optional[int] = None,
+                 decay_step_size: int = 0, decay_lr_rate: float = 0.0,
+                 cycle_min_mom: float = 0.85, cycle_max_mom: float = 0.99,
+                 cycle_momentum: bool = True, decay_mom_rate: float = 0.0,
+                 last_batch_iteration: int = -1):
+        lo, hi = float(cycle_min_lr), float(cycle_max_lr)
+        up = max(int(cycle_first_step_size), 1)
+        down = int(cycle_second_step_size) if cycle_second_step_size else up
+        cycle_len = up + down
+        dr = float(decay_lr_rate)
+        ds = max(int(decay_step_size), 1)
+
+        def fn(step):
+            in_cycle = step < cycle_len
+            pos_up = jnp.clip(step / up, 0.0, 1.0)
+            pos_down = jnp.clip((step - up) / down, 0.0, 1.0)
+            cyc = jnp.where(step < up, lo + (hi - lo) * pos_up,
+                            hi - (hi - lo) * pos_down)
+            post = jnp.maximum(step - cycle_len, 0.0)
+            decayed = lo * (1.0 / (1.0 + dr * post / ds)) if dr > 0 else jnp.full_like(cyc, lo)
+            return jnp.where(in_cycle, cyc, decayed)
+
+        super().__init__(fn)
+        self.last_step = last_batch_iteration + 1
+        m_lo, m_hi = float(cycle_min_mom), float(cycle_max_mom)
+        dm = float(decay_mom_rate)
+
+        def mom_fn(step):
+            pos_up = jnp.clip(step / up, 0.0, 1.0)
+            pos_down = jnp.clip((step - up) / down, 0.0, 1.0)
+            cyc = jnp.where(step < up, m_hi - (m_hi - m_lo) * pos_up,
+                            m_lo + (m_hi - m_lo) * pos_down)
+            post = jnp.maximum(step - cycle_len, 0.0)
+            decayed = m_hi * (1.0 + dm * post / ds) if dm > 0 else jnp.full_like(cyc, m_hi)
+            return jnp.where(step < cycle_len, cyc, jnp.minimum(decayed, m_hi))
+
+        self._mom_fn = mom_fn if cycle_momentum else None
+
+    def momentum_at(self, step):
+        if self._mom_fn is None:
+            return None
+        return self._mom_fn(jnp.asarray(step, jnp.float32))
+
+
+class LRRangeTest(_Schedule):
+    """LR range test: ramp lr by step_rate every step_size steps, linearly or
+    staircase (reference :301)."""
+
+    def __init__(self, lr_range_test_min_lr: float = 1e-3,
+                 lr_range_test_step_size: int = 2000,
+                 lr_range_test_step_rate: float = 1.0,
+                 lr_range_test_staircase: bool = False,
+                 last_batch_iteration: int = -1):
+        lo = float(lr_range_test_min_lr)
+        size = max(int(lr_range_test_step_size), 1)
+        rate = float(lr_range_test_step_rate)
+
+        def fn(step):
+            interval = jnp.floor(step / size) if lr_range_test_staircase else step / size
+            return lo * (1.0 + rate * interval)
+
+        super().__init__(fn)
+        self.last_step = last_batch_iteration + 1
+
+
+SCHEDULE_REGISTRY = {
+    "WarmupLR": WarmupLR,
+    "WarmupDecayLR": WarmupDecayLR,
+    "OneCycle": OneCycle,
+    "LRRangeTest": LRRangeTest,
+}
+
+
+def build_lr_schedule(name: Optional[str], params: Dict) -> Optional[_Schedule]:
+    if name is None:
+        return None
+    if name not in SCHEDULE_REGISTRY:
+        raise ValueError(f"unknown scheduler '{name}'; valid: {VALID_SCHEDULES}")
+    return SCHEDULE_REGISTRY[name](**params)
+
+
+def add_tuning_arguments(parser):
+    """argparse LR-tuning overrides (reference lr_schedules.py:54-240)."""
+    group = parser.add_argument_group("Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help=f"LR schedule, one of {VALID_SCHEDULES}")
+    group.add_argument(f"--{LR_RANGE_TEST_MIN_LR}", type=float, default=0.001)
+    group.add_argument(f"--{LR_RANGE_TEST_STEP_SIZE}", type=int, default=1000)
+    group.add_argument(f"--{LR_RANGE_TEST_STEP_RATE}", type=float, default=1.0)
+    group.add_argument(f"--{LR_RANGE_TEST_STAIRCASE}", action="store_true")
+    group.add_argument(f"--{WARMUP_MIN_LR}", type=float, default=0.0)
+    group.add_argument(f"--{WARMUP_MAX_LR}", type=float, default=0.001)
+    group.add_argument(f"--{WARMUP_NUM_STEPS}", type=int, default=1000)
+    group.add_argument(f"--{TOTAL_NUM_STEPS}", type=int, default=10000)
+    return parser

@@ -1,0 +1,128 @@
+"""Mixed precision + dynamic loss scaling.
+
+Parity with the reference's ``deepspeed/runtime/fp16/loss_scaler.py``
+(``LossScaler`` :34, ``DynamicLossScaler`` :56) and the FP16 optimizer wrap
+(``fp16/fused_optimizer.py:17``).
+
+TPU-first: bf16 is the native mixed-precision mode and needs *no* loss
+scaling (same exponent range as fp32); fp16 support keeps the dynamic scaler
+for capability parity. The scaler state is a pytree carried inside the jitted
+train step — scale growth/backoff and the skip-step decision are traced
+``jnp.where`` branches, so overflow handling costs no recompilation and no
+host sync (the reference needed an allreduce + host readback per step,
+engine.py:1253-1302).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array          # fp32 scalar, current loss scale
+    good_steps: jax.Array     # int32, consecutive non-overflow steps
+    hysteresis: jax.Array     # int32, remaining tolerated overflows before backoff
+
+
+class DynamicLossScaler:
+    """Pure functional dynamic loss scaler.
+
+    Growth: after ``scale_window`` consecutive good steps, scale *= scale_factor.
+    Backoff: on overflow, hysteresis decrements; when exhausted scale /= factor
+    (min ``min_scale``). Mirrors reference loss_scaler.py:56-131 semantics.
+    """
+
+    def __init__(self, init_scale: float = 2.0**32, scale_factor: float = 2.0,
+                 scale_window: int = 1000, min_scale: float = 1.0,
+                 hysteresis: int = 2):
+        self.init_scale = float(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.hysteresis = int(hysteresis)
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(scale=jnp.float32(self.init_scale),
+                              good_steps=jnp.zeros((), jnp.int32),
+                              hysteresis=jnp.full((), self.hysteresis, jnp.int32))
+
+    def update(self, state: LossScaleState, overflow: jax.Array) -> LossScaleState:
+        hys = jnp.where(overflow, jnp.maximum(state.hysteresis - 1, 0), state.hysteresis)
+        backoff = overflow & (hys == 0)
+        new_scale = jnp.where(
+            backoff,
+            jnp.maximum(state.scale / self.scale_factor, self.min_scale),
+            state.scale)
+        good = jnp.where(overflow, 0, state.good_steps + 1)
+        grow = (~overflow) & (good >= self.scale_window)
+        new_scale = jnp.where(grow, new_scale * self.scale_factor, new_scale)
+        good = jnp.where(grow, 0, good)
+        hys = jnp.where(backoff, self.hysteresis, hys)
+        hys = jnp.where(grow | (~overflow), jnp.full((), self.hysteresis, jnp.int32), hys)
+        return LossScaleState(scale=new_scale, good_steps=good, hysteresis=hys)
+
+
+class StaticLossScaler:
+    """Fixed loss scale (reference LossScaler :34)."""
+
+    def __init__(self, scale: float = 1.0):
+        self.scale = float(scale)
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(scale=jnp.float32(self.scale),
+                              good_steps=jnp.zeros((), jnp.int32),
+                              hysteresis=jnp.zeros((), jnp.int32))
+
+    def update(self, state: LossScaleState, overflow: jax.Array) -> LossScaleState:
+        return state
+
+
+def make_loss_scaler(fp16_enabled: bool, dynamic: bool, static_scale: float,
+                     initial_scale_power: int, scale_window: int,
+                     min_scale: float, hysteresis: int):
+    if not fp16_enabled:
+        return StaticLossScaler(1.0)
+    if dynamic:
+        return DynamicLossScaler(init_scale=2.0**initial_scale_power,
+                                 scale_window=scale_window,
+                                 min_scale=min_scale, hysteresis=hysteresis)
+    return StaticLossScaler(static_scale)
+
+
+# ---------------------------------------------------------------------------
+# Precision policy
+# ---------------------------------------------------------------------------
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+class PrecisionPolicy:
+    """Casting rules: compute dtype for fwd/bwd, fp32 master for the update.
+
+    Equivalent to the reference's model.half() + fp32 master copies
+    (engine.py:642, fused_optimizer.py). ``cast_params`` produces the compute
+    copy fed to the loss fn; masters stay fp32.
+    """
+
+    def __init__(self, dtype_name: str):
+        if dtype_name not in _DTYPES:
+            raise ValueError(f"unknown precision {dtype_name}")
+        self.name = dtype_name
+        self.dtype = _DTYPES[dtype_name]
+        self.mixed = dtype_name != "float32"
+
+    def cast_params(self, params):
+        if not self.mixed:
+            return params
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(self.dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params)
+
+    def cast_batch(self, batch):
+        if not self.mixed:
+            return batch
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x,
+            batch)

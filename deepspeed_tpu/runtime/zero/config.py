@@ -1,0 +1,169 @@
+"""ZeRO configuration.
+
+Key names match the reference (``deepspeed/runtime/zero/config.py`` and
+``zero/constants.py``) so DeepSpeed JSON configs parse unchanged.
+
+TPU semantics: stages 1-3 are realised as sharding rules over the ``data``
+mesh axis (see ``runtime/zero/partition.py``) rather than torch flat-buffer
+surgery, so several GPU-era knobs (bucket sizes, overlap_comm) are accepted,
+validated, and recorded, but only influence behaviour where XLA exposes an
+equivalent lever (e.g. ``overlap_comm`` toggles the latency-hiding scheduler
+hint; bucket sizes inform the compressed-collective chunking).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+ZERO_OPTIMIZATION = "zero_optimization"
+
+ZERO_STAGE = "stage"
+ZERO_STAGE_DEFAULT = 0
+
+ALLGATHER_PARTITIONS = "allgather_partitions"
+ALLGATHER_PARTITIONS_DEFAULT = True
+ALLGATHER_BUCKET_SIZE = "allgather_bucket_size"
+ALLGATHER_BUCKET_SIZE_DEFAULT = 5e8
+OVERLAP_COMM = "overlap_comm"
+OVERLAP_COMM_DEFAULT = False
+REDUCE_SCATTER = "reduce_scatter"
+REDUCE_SCATTER_DEFAULT = True
+REDUCE_BUCKET_SIZE = "reduce_bucket_size"
+REDUCE_BUCKET_SIZE_DEFAULT = 5e8
+CONTIGUOUS_GRADIENTS = "contiguous_gradients"
+CONTIGUOUS_GRADIENTS_DEFAULT = False
+CPU_OFFLOAD = "cpu_offload"  # legacy stage-2 flag
+ELASTIC_CHECKPOINT = "elastic_checkpoint"
+ELASTIC_CHECKPOINT_DEFAULT = True
+LEGACY_STAGE1 = "legacy_stage1"
+
+OFFLOAD_PARAM = "offload_param"
+OFFLOAD_OPTIMIZER = "offload_optimizer"
+OFFLOAD_DEVICE = "device"
+OFFLOAD_DEVICE_NONE = "none"
+OFFLOAD_DEVICE_CPU = "cpu"
+OFFLOAD_DEVICE_NVME = "nvme"
+OFFLOAD_NVME_PATH = "nvme_path"
+OFFLOAD_BUFFER_COUNT = "buffer_count"
+OFFLOAD_BUFFER_SIZE = "buffer_size"
+OFFLOAD_MAX_IN_CPU = "max_in_cpu"
+OFFLOAD_PIN_MEMORY = "pin_memory"
+OFFLOAD_PIPELINE = "pipeline"
+
+SUB_GROUP_SIZE = "sub_group_size"
+SUB_GROUP_SIZE_DEFAULT = 1e9
+
+STAGE3_MAX_LIVE_PARAMETERS = "stage3_max_live_parameters"
+STAGE3_MAX_LIVE_PARAMETERS_DEFAULT = 1e9
+STAGE3_MAX_REUSE_DISTANCE = "stage3_max_reuse_distance"
+STAGE3_MAX_REUSE_DISTANCE_DEFAULT = 1e9
+STAGE3_PREFETCH_BUCKET_SIZE = "stage3_prefetch_bucket_size"
+STAGE3_PREFETCH_BUCKET_SIZE_DEFAULT = 5e8
+STAGE3_PARAM_PERSISTENCE_THRESHOLD = "stage3_param_persistence_threshold"
+STAGE3_PARAM_PERSISTENCE_THRESHOLD_DEFAULT = 1e5
+STAGE3_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE = "stage3_gather_fp16_weights_on_model_save"
+
+
+@dataclass
+class ZeroOffloadConfig:
+    """Offload target for optimizer state or parameters (ZeRO-Offload/Infinity)."""
+
+    device: str = OFFLOAD_DEVICE_NONE
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: float = 1e8
+    max_in_cpu: float = 1e9
+    pin_memory: bool = False
+    pipeline: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ZeroOffloadConfig":
+        if d is None:
+            return cls()
+        if not isinstance(d, dict):
+            raise ValueError(f"offload config must be a dict, got {type(d)}")
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown offload config keys: {sorted(unknown)}")
+        return cls(**d)
+
+    @property
+    def enabled(self) -> bool:
+        return self.device not in (None, OFFLOAD_DEVICE_NONE)
+
+
+@dataclass
+class ZeroConfig:
+    stage: int = ZERO_STAGE_DEFAULT
+    allgather_partitions: bool = ALLGATHER_PARTITIONS_DEFAULT
+    allgather_bucket_size: float = ALLGATHER_BUCKET_SIZE_DEFAULT
+    overlap_comm: bool = OVERLAP_COMM_DEFAULT
+    reduce_scatter: bool = REDUCE_SCATTER_DEFAULT
+    reduce_bucket_size: float = REDUCE_BUCKET_SIZE_DEFAULT
+    contiguous_gradients: bool = CONTIGUOUS_GRADIENTS_DEFAULT
+    elastic_checkpoint: bool = ELASTIC_CHECKPOINT_DEFAULT
+    offload_param: ZeroOffloadConfig = field(default_factory=ZeroOffloadConfig)
+    offload_optimizer: ZeroOffloadConfig = field(default_factory=ZeroOffloadConfig)
+    sub_group_size: float = SUB_GROUP_SIZE_DEFAULT
+    max_live_parameters: float = STAGE3_MAX_LIVE_PARAMETERS_DEFAULT
+    max_reuse_distance: float = STAGE3_MAX_REUSE_DISTANCE_DEFAULT
+    prefetch_bucket_size: float = STAGE3_PREFETCH_BUCKET_SIZE_DEFAULT
+    param_persistence_threshold: float = STAGE3_PARAM_PERSISTENCE_THRESHOLD_DEFAULT
+    gather_fp16_weights_on_model_save: bool = False
+    legacy_stage1: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ZeroConfig":
+        if d is None:
+            return cls()
+        if not isinstance(d, dict):
+            raise ValueError(f"{ZERO_OPTIMIZATION} must be a dict, got {type(d)}")
+        d = dict(d)
+        cfg = cls()
+        cfg.stage = int(d.pop(ZERO_STAGE, ZERO_STAGE_DEFAULT))
+        if cfg.stage not in (0, 1, 2, 3):
+            raise ValueError(f"ZeRO stage must be 0-3, got {cfg.stage}")
+        cfg.allgather_partitions = bool(d.pop(ALLGATHER_PARTITIONS, cfg.allgather_partitions))
+        cfg.allgather_bucket_size = float(d.pop(ALLGATHER_BUCKET_SIZE, cfg.allgather_bucket_size))
+        cfg.overlap_comm = bool(d.pop(OVERLAP_COMM, cfg.overlap_comm))
+        cfg.reduce_scatter = bool(d.pop(REDUCE_SCATTER, cfg.reduce_scatter))
+        cfg.reduce_bucket_size = float(d.pop(REDUCE_BUCKET_SIZE, cfg.reduce_bucket_size))
+        cfg.contiguous_gradients = bool(d.pop(CONTIGUOUS_GRADIENTS, cfg.contiguous_gradients))
+        cfg.elastic_checkpoint = bool(d.pop(ELASTIC_CHECKPOINT, cfg.elastic_checkpoint))
+        cfg.sub_group_size = float(d.pop(SUB_GROUP_SIZE, cfg.sub_group_size))
+        cfg.max_live_parameters = float(d.pop(STAGE3_MAX_LIVE_PARAMETERS, cfg.max_live_parameters))
+        cfg.max_reuse_distance = float(d.pop(STAGE3_MAX_REUSE_DISTANCE, cfg.max_reuse_distance))
+        cfg.prefetch_bucket_size = float(d.pop(STAGE3_PREFETCH_BUCKET_SIZE, cfg.prefetch_bucket_size))
+        cfg.param_persistence_threshold = float(
+            d.pop(STAGE3_PARAM_PERSISTENCE_THRESHOLD, cfg.param_persistence_threshold))
+        cfg.gather_fp16_weights_on_model_save = bool(
+            d.pop(STAGE3_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE, cfg.gather_fp16_weights_on_model_save))
+        cfg.legacy_stage1 = bool(d.pop(LEGACY_STAGE1, cfg.legacy_stage1))
+        cfg.offload_param = ZeroOffloadConfig.from_dict(d.pop(OFFLOAD_PARAM, None))
+        cfg.offload_optimizer = ZeroOffloadConfig.from_dict(d.pop(OFFLOAD_OPTIMIZER, None))
+        # Legacy stage-2 flag: cpu_offload=true ≡ offload_optimizer.device=cpu.
+        if d.pop(CPU_OFFLOAD, False):
+            cfg.offload_optimizer = ZeroOffloadConfig(device=OFFLOAD_DEVICE_CPU)
+        unknown = set(d)
+        if unknown:
+            raise ValueError(f"unknown {ZERO_OPTIMIZATION} keys: {sorted(unknown)}")
+        return cfg
+
+    @property
+    def enabled(self) -> bool:
+        return self.stage > 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            ZERO_STAGE: self.stage,
+            ALLGATHER_PARTITIONS: self.allgather_partitions,
+            ALLGATHER_BUCKET_SIZE: self.allgather_bucket_size,
+            OVERLAP_COMM: self.overlap_comm,
+            REDUCE_SCATTER: self.reduce_scatter,
+            REDUCE_BUCKET_SIZE: self.reduce_bucket_size,
+            CONTIGUOUS_GRADIENTS: self.contiguous_gradients,
+            ELASTIC_CHECKPOINT: self.elastic_checkpoint,
+            SUB_GROUP_SIZE: self.sub_group_size,
+            OFFLOAD_OPTIMIZER: {"device": self.offload_optimizer.device},
+            OFFLOAD_PARAM: {"device": self.offload_param.device},
+        }

@@ -1,0 +1,84 @@
+"""Logging utilities.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` and the
+rank-filtered ``log_dist`` helper from ``deepspeed/utils/__init__.py``: a
+singleton package logger plus helpers that only emit on selected process ranks.
+
+On TPU the "rank" is the JAX process index (one process per host); we avoid
+importing jax at module import time so the logger is usable before
+``jax.distributed.initialize``.
+"""
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class _LoggerFactory:
+    @staticmethod
+    def create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+        log = logging.getLogger(name)
+        log.setLevel(level)
+        log.propagate = False
+        if not log.handlers:
+            formatter = logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
+                datefmt="%Y-%m-%d %H:%M:%S",
+            )
+            handler = logging.StreamHandler(stream=sys.stdout)
+            handler.setFormatter(formatter)
+            log.addHandler(handler)
+        return log
+
+
+logger = _LoggerFactory.create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO)
+)
+
+
+def _process_index() -> int:
+    """Current process rank without forcing distributed init."""
+    # Prefer the env var set by our launcher; fall back to jax if initialised.
+    for var in ("DSTPU_RANK", "JAX_PROCESS_INDEX", "RANK"):
+        if var in os.environ:
+            try:
+                return int(os.environ[var])
+            except ValueError:
+                pass
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (``None`` / ``[-1]`` = all).
+
+    Mirrors the reference's ``deepspeed/utils/__init__.py`` ``log_dist``.
+    """
+    ranks = list(ranks) if ranks is not None else []
+    my_rank = _process_index()
+    if not ranks or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        logger.info(message)
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,164 @@
+"""Wall-clock and throughput timers.
+
+Capability parity with the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` at :19, ``ThroughputTimer`` at :100). The CUDA
+``synchronize()`` barrier becomes a block-until-ready on the JAX default
+device: XLA dispatch is async exactly like CUDA streams, so timers must drain
+the device queue before reading the host clock.
+"""
+
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _device_synchronize() -> None:
+    """Drain outstanding device work (the TPU analogue of cuda.synchronize)."""
+    try:
+        import jax
+
+        # A tiny transfer forces completion of everything already enqueued on
+        # the same stream-ordered executor.
+        jax.block_until_ready(jax.device_put(0.0))
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name_ = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = 0.0
+        self.count = 0
+
+    def start(self) -> None:
+        assert not self.started_, f"timer {self.name_} has already been started"
+        _device_synchronize()
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, reset: bool = False) -> None:
+        assert self.started_, f"timer {self.name_} is not started"
+        _device_synchronize()
+        if reset:
+            self.elapsed_ = time.time() - self.start_time
+        else:
+            self.elapsed_ += time.time() - self.start_time
+        self.count += 1
+        self.started_ = False
+
+    def reset(self) -> None:
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.count = 0
+
+    def elapsed(self, reset: bool = True) -> float:
+        started = self.started_
+        if started:
+            self.stop()
+        elapsed = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return elapsed
+
+    def mean(self) -> float:
+        return self.elapsed_ / max(self.count, 1)
+
+
+class SynchronizedWallClockTimer:
+    """Named timers with device synchronisation, used for wall-clock breakdown."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"HBM in-use {in_use:.2f} GB | peak {peak:.2f} GB"
+        except Exception:
+            return "HBM stats unavailable"
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None) -> None:
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed_time:.2f}"
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        log_dist(string, ranks=ranks or [0])
+
+
+class ThroughputTimer:
+    """Samples/sec tracker, skipping warm-up steps (reference ``timer.py:100``)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: Optional[int] = None, monitor_memory: bool = False):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+
+    def update_epoch_count(self) -> None:
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self) -> None:
+        self.initialized = True
+
+    def start(self) -> None:
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_synchronize()
+            self.start_time = time.time()
+
+    def stop(self, report_speed: bool = True) -> None:
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        self.global_step_count += 1
+        if self.start_time > 0:
+            _device_synchronize()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            if report_speed and self.steps_per_output and \
+                    self.global_step_count % self.steps_per_output == 0:
+                log_dist(
+                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, "
+                    f"throughput: {self.avg_samples_per_sec():.2f} samples/sec",
+                    ranks=[0])
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = (self.global_step_count - self.start_step) * self.batch_size
+            return samples / self.total_elapsed_time
+        return float("-1")

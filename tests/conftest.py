@@ -1,0 +1,39 @@
+"""Test harness.
+
+The reference's answer to "multi-node without a cluster" is forking N
+processes over NCCL/Gloo on one host (tests/unit/common.py:16
+@distributed_test). The TPU-native answer is simpler and faster: a single
+process with a virtual 8-device CPU mesh
+(--xla_force_host_platform_device_count), over which real NamedSharding /
+collective lowering runs exactly as on a pod. Real-TPU tests can opt in via
+DSTPU_TEST_TPU=1.
+"""
+
+import os
+
+# Must happen before any backend initialisation. The axon sitecustomize
+# imports jax at interpreter start, so env vars alone are too late — use
+# jax.config.update, which works any time before first device use.
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+if os.environ.get("DSTPU_TEST_TPU", "0") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

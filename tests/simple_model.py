@@ -1,0 +1,52 @@
+"""Tiny model fixtures (analogue of the reference tests/unit/simple_model.py)."""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mlp_params(key=0, hidden: int = 16, layers: int = 2, out: int = 8) -> Dict:
+    """A small MLP param tree with dims divisible by 8 (test mesh size)."""
+    rng = np.random.default_rng(key)
+    params = {}
+    dim_in = hidden
+    for i in range(layers):
+        params[f"layer_{i}"] = {
+            "w": rng.standard_normal((dim_in, hidden)).astype(np.float32) * 0.1,
+            "b": np.zeros((hidden,), np.float32),
+        }
+        dim_in = hidden
+    params["head"] = {
+        "w": rng.standard_normal((hidden, out)).astype(np.float32) * 0.1,
+        "b": np.zeros((out,), np.float32),
+    }
+    return params
+
+
+def mlp_loss_fn(params, batch, rng):
+    """MSE regression loss; batch = {'x': [B, H], 'y': [B, O]}."""
+    h = batch["x"]
+    i = 0
+    while f"layer_{i}" in params:
+        layer = params[f"layer_{i}"]
+        h = jnp.tanh(h @ layer["w"] + layer["b"])
+        i += 1
+    pred = h @ params["head"]["w"] + params["head"]["b"]
+    return jnp.mean(jnp.square(pred - batch["y"]))
+
+
+def random_batch(rng, batch_size: int = 8, hidden: int = 16, out: int = 8):
+    return {
+        "x": rng.standard_normal((batch_size, hidden)).astype(np.float32),
+        "y": rng.standard_normal((batch_size, out)).astype(np.float32),
+    }
+
+
+def random_batches(rng, gas: int, batch_size: int = 8, hidden: int = 16, out: int = 8):
+    """Stacked micro-batches with leading GAS dim (train_batch path)."""
+    return {
+        "x": rng.standard_normal((gas, batch_size, hidden)).astype(np.float32),
+        "y": rng.standard_normal((gas, batch_size, out)).astype(np.float32),
+    }

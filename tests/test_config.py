@@ -1,0 +1,118 @@
+"""Config parsing + batch-triple solver (reference tests/unit/test_config.py,
+test_ds_config.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config.config import ConfigError, DeepSpeedTPUConfig
+
+
+def test_batch_triple_all_given_consistent():
+    c = DeepSpeedTPUConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+         "gradient_accumulation_steps": 2}, world_size=4)
+    assert c.train_batch_size == 32
+    assert c.gradient_accumulation_steps == 2
+
+
+def test_batch_triple_inconsistent_raises():
+    with pytest.raises(ConfigError):
+        DeepSpeedTPUConfig(
+            {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+             "gradient_accumulation_steps": 3}, world_size=4)
+
+
+def test_batch_triple_infer_gas():
+    c = DeepSpeedTPUConfig(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4}, world_size=4)
+    assert c.gradient_accumulation_steps == 4
+
+
+def test_batch_triple_infer_micro():
+    c = DeepSpeedTPUConfig(
+        {"train_batch_size": 64, "gradient_accumulation_steps": 2}, world_size=4)
+    assert c.train_micro_batch_size_per_gpu == 8
+
+
+def test_batch_triple_infer_train():
+    c = DeepSpeedTPUConfig({"train_micro_batch_size_per_gpu": 4}, world_size=4)
+    assert c.train_batch_size == 16
+    assert c.gradient_accumulation_steps == 1
+
+
+def test_batch_triple_none_raises():
+    with pytest.raises(ConfigError):
+        DeepSpeedTPUConfig({}, world_size=4)
+
+
+def test_micro_batch_chip_alias():
+    c = DeepSpeedTPUConfig({"train_micro_batch_size_per_chip": 2}, world_size=2)
+    assert c.train_micro_batch_size_per_gpu == 2
+
+
+def test_zero_config_parsing():
+    c = DeepSpeedTPUConfig(
+        {"train_batch_size": 8,
+         "zero_optimization": {"stage": 2, "overlap_comm": True,
+                               "offload_optimizer": {"device": "cpu"}}},
+        world_size=1)
+    assert c.zero_config.stage == 2
+    assert c.zero_config.overlap_comm
+    assert c.zero_config.offload_optimizer.device == "cpu"
+    assert c.zero_enabled
+
+
+def test_zero_unknown_key_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedTPUConfig(
+            {"train_batch_size": 8, "zero_optimization": {"stage": 1, "bogus": 1}},
+            world_size=1)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ConfigError):
+        DeepSpeedTPUConfig(
+            {"train_batch_size": 8, "fp16": {"enabled": True},
+             "bf16": {"enabled": True}}, world_size=1)
+
+
+def test_precision_selection():
+    c = DeepSpeedTPUConfig({"train_batch_size": 8, "bf16": {"enabled": True}},
+                           world_size=1)
+    assert c.precision_dtype == "bfloat16"
+    c = DeepSpeedTPUConfig({"train_batch_size": 8, "fp16": {"enabled": True}},
+                           world_size=1)
+    assert c.precision_dtype == "float16"
+    assert c.dynamic_loss_scale
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 16,
+                             "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                             "scheduler": {"type": "WarmupLR",
+                                           "params": {"warmup_num_steps": 10}}}))
+    c = DeepSpeedTPUConfig(str(p), world_size=2)
+    assert c.optimizer_name == "adam"
+    assert c.scheduler_name == "WarmupLR"
+    assert c.optimizer_params["lr"] == 1e-3
+
+
+def test_mesh_block():
+    c = DeepSpeedTPUConfig({"train_batch_size": 8, "mesh": {"model": 2}},
+                           world_size=8)
+    assert c.data_parallel_size == 4
+
+
+def test_mesh_indivisible_raises():
+    with pytest.raises(ConfigError):
+        DeepSpeedTPUConfig({"train_batch_size": 8, "mesh": {"model": 3}},
+                           world_size=8)
+
+
+def test_zero2_with_pipeline_raises():
+    with pytest.raises(ConfigError):
+        DeepSpeedTPUConfig(
+            {"train_batch_size": 8, "mesh": {"pipe": 2},
+             "zero_optimization": {"stage": 2}}, world_size=8)

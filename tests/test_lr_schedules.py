@@ -1,0 +1,69 @@
+"""LR schedule tests (reference tests/unit/test_lr_schedulers.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle, WarmupLR,
+                                                WarmupDecayLR, build_lr_schedule)
+
+
+def test_warmup_lr():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+    assert float(s.lr_at(0)) == pytest.approx(0.0)
+    assert float(s.lr_at(5)) == pytest.approx(0.05)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(100)) == pytest.approx(0.1)
+
+
+def test_warmup_decay_lr():
+    s = WarmupDecayLR(total_num_steps=100, warmup_min_lr=0.0,
+                      warmup_max_lr=0.1, warmup_num_steps=10)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(55)) == pytest.approx(0.05)
+    assert float(s.lr_at(100)) == pytest.approx(0.0)
+
+
+def test_one_cycle_shape():
+    s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10)
+    assert float(s.lr_at(0)) == pytest.approx(0.01)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(20)) == pytest.approx(0.01)
+    # momentum cycles inversely
+    assert float(s.momentum_at(0)) == pytest.approx(0.99)
+    assert float(s.momentum_at(10)) == pytest.approx(0.85)
+
+
+def test_lr_range_test():
+    s = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                    lr_range_test_step_rate=1.0)
+    assert float(s.lr_at(0)) == pytest.approx(0.01)
+    assert float(s.lr_at(10)) == pytest.approx(0.02)
+    s2 = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                     lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    assert float(s2.lr_at(5)) == pytest.approx(0.01)
+
+
+def test_stateful_surface():
+    s = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    for _ in range(5):
+        s.step()
+    assert s.get_lr() == pytest.approx(0.05)
+    sd = s.state_dict()
+    s2 = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    s2.load_state_dict(sd)
+    assert s2.get_lr() == pytest.approx(s.get_lr())
+
+
+def test_registry():
+    s = build_lr_schedule("WarmupLR", {"warmup_num_steps": 5})
+    assert s is not None
+    with pytest.raises(ValueError):
+        build_lr_schedule("Nope", {})
+    assert build_lr_schedule(None, {}) is None
+
+
+def test_monotone_warmup():
+    s = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=100)
+    lrs = [float(s.lr_at(i)) for i in range(0, 100, 10)]
+    assert all(b >= a for a, b in zip(lrs, lrs[1:]))
+    assert not np.isnan(lrs).any()

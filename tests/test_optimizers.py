@@ -1,0 +1,100 @@
+"""Optimizer numerics vs torch reference (reference tests/unit/test_adamw.py,
+test_cpu_adam.py methodology: identical weights/grads, compare updates)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, FusedAdamW
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+
+torch = pytest.importorskip("torch")
+
+
+def _tree_from(arrs):
+    return {f"p{i}": jnp.asarray(a) for i, a in enumerate(arrs)}
+
+
+def _run_jax_adam(params_np, grads_np, steps, **kw):
+    opt = FusedAdam(**kw)
+    params = _tree_from(params_np)
+    state = opt.init(params)
+    grads = _tree_from(grads_np)
+    for _ in range(steps):
+        params, state = opt.update(grads, state, params)
+    return [np.asarray(params[f"p{i}"]) for i in range(len(params_np))]
+
+
+def _run_torch(params_np, grads_np, steps, opt_cls, **kw):
+    tp = [torch.nn.Parameter(torch.tensor(a)) for a in params_np]
+    opt = opt_cls(tp, **kw)
+    for _ in range(steps):
+        for p, g in zip(tp, grads_np):
+            p.grad = torch.tensor(g)
+        opt.step()
+    return [p.detach().numpy() for p in tp]
+
+
+@pytest.mark.parametrize("steps", [1, 10])
+def test_adamw_matches_torch(steps, rng):
+    params = [rng.standard_normal((4, 8)).astype(np.float32),
+              rng.standard_normal((16,)).astype(np.float32)]
+    grads = [rng.standard_normal(p.shape).astype(np.float32) * 0.1 for p in params]
+    ours = _run_jax_adam(params, grads, steps, lr=1e-2, weight_decay=0.01,
+                         adamw_mode=True)
+    ref = _run_torch(params, grads, steps, torch.optim.AdamW, lr=1e-2,
+                     weight_decay=0.01)
+    for a, b in zip(ours, ref):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("steps", [1, 10])
+def test_adam_l2_matches_torch(steps, rng):
+    params = [rng.standard_normal((8, 8)).astype(np.float32)]
+    grads = [rng.standard_normal(p.shape).astype(np.float32) * 0.1 for p in params]
+    ours = _run_jax_adam(params, grads, steps, lr=1e-2, weight_decay=0.01,
+                         adamw_mode=False)
+    ref = _run_torch(params, grads, steps, torch.optim.Adam, lr=1e-2,
+                     weight_decay=0.01)
+    for a, b in zip(ours, ref):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_adam_no_bias_correction_differs(rng):
+    p = [rng.standard_normal((4,)).astype(np.float32)]
+    g = [np.ones((4,), np.float32)]
+    with_bc = _run_jax_adam(p, g, 1, lr=1e-2, bias_correction=True)
+    without = _run_jax_adam(p, g, 1, lr=1e-2, bias_correction=False)
+    assert not np.allclose(with_bc[0], without[0])
+
+
+def test_lamb_trust_ratio_bounds(rng):
+    opt = FusedLamb(lr=1e-2, max_coeff=10.0, min_coeff=0.01)
+    params = {"w": jnp.asarray(rng.standard_normal((8, 8)).astype(np.float32))}
+    state = opt.init(params)
+    grads = {"w": jnp.asarray(rng.standard_normal((8, 8)).astype(np.float32))}
+    new_params, state = opt.update(grads, state, params)
+    # the step moved the weights, and not absurdly far
+    delta = np.abs(np.asarray(new_params["w"] - params["w"])).max()
+    assert 0 < delta < 1.0
+
+
+def test_lamb_decreases_quadratic(rng):
+    opt = FusedLamb(lr=0.1)
+    params = {"w": jnp.asarray(rng.standard_normal((16,)).astype(np.float32))}
+    state = opt.init(params)
+
+    def loss(p):
+        return jnp.sum(jnp.square(p["w"]))
+
+    l0 = float(loss(params))
+    for _ in range(20):
+        grads = jax.grad(loss)(params)
+        params, state = opt.update(grads, state, params)
+    assert float(loss(params)) < l0
+
+
+def test_amsgrad_rejected():
+    with pytest.raises(NotImplementedError):
+        FusedAdam(amsgrad=True)

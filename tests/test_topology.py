@@ -1,0 +1,80 @@
+"""Topology/grid unit tests (reference tests/unit/test_topology.py — pure
+Python, no devices)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (PipeDataParallelTopology,
+                                             PipeModelDataParallelTopology,
+                                             PipelineParallelGrid,
+                                             ProcessTopology)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+
+
+def test_topology_coord_roundtrip():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+    for r in range(8):
+        c = topo.get_coord(r)
+        assert topo.get_rank(pipe=c.pipe, data=c.data) == r
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert pipe_lists == [[0, 2], [1, 3]]
+    data_lists = topo.get_axis_comm_lists("data")
+    assert data_lists == [[0, 1], [2, 3]]
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.world_size() == 8
+    ranks = topo.filter_match(pipe=0)
+    assert len(ranks) == 4
+    assert all(topo.get_coord(r).pipe == 0 for r in ranks)
+
+
+def test_topology_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=1)
+    s = topo.get_rank_repr(rank=0)
+    assert "pipe_00" in s and "model_00" in s
+
+
+def test_grid_stage_ids():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = PipelineParallelGrid(topo, global_rank=5)
+    assert grid.pipe_parallel_size == 4
+    assert grid.data_parallel_size == 2
+    coord = topo.get_coord(5)
+    assert grid.stage_id == coord.pipe
+    assert grid.data_parallel_id == coord.data
+    assert not grid.is_first_stage() or coord.pipe == 0
+
+
+def test_grid_p2p_pairs_cover_all_stages():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = PipelineParallelGrid(topo, global_rank=0)
+    # each dp slice contributes num_pp pairs (incl. wraparound)
+    assert len(grid.p2p_matrix) == 4 * 2
+
+
+def test_grid_stage_to_global():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    grid = PipelineParallelGrid(topo, global_rank=0)
+    r = grid.stage_to_global(stage_id=1)
+    assert topo.get_coord(r).pipe == 1
+    assert topo.get_coord(r).data == grid.data_parallel_id
+
+
+def test_invalid_axes():
+    with pytest.raises(ValueError):
+        ProcessTopology(axes=["a", "a"], dims=[2, 2])
+    with pytest.raises(ValueError):
+        ProcessTopology(axes=["a"], dims=[2, 2])
