@@ -1,0 +1,90 @@
+"""Headline benchmark — BERT-large ZeRO-2 pretraining throughput per chip.
+
+Mirrors the reference's flagship number: BERT-Large seq-128 pretraining at
+272 samples/s on one V100 with the fused CUDA transformer kernel
+(reference docs/_tutorials/bert-pretraining.md:387, BASELINE.md). Here the
+same workload runs through the TPU engine (bf16, ZeRO-2 placement, fused
+train_batch step) on however many chips are visible; the reported metric is
+samples/sec/chip and ``vs_baseline`` is the ratio against the 272 V100
+number.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC = 272.0  # 1x V100, BERT-Large seq128, fused kernels
+
+
+def main():
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import make_bert
+
+    if on_tpu:
+        model_name, micro_bs, seq, steps, warmup = "bert-large", 32, 128, 10, 3
+    else:  # smoke mode off-TPU (CI/dev boxes) — same code path, tiny shapes
+        model_name, micro_bs, seq, steps, warmup = "tiny", 8, 64, 3, 1
+
+    model, cfg = make_bert(model_name, dropout_rate=0.0, remat=on_tpu,
+                           max_seq_len=max(seq, 128))
+    rng = np.random.default_rng(0)
+    n_chips = max(len(jax.devices()), 1)
+    global_bs = micro_bs * n_chips
+
+    def make_batch():
+        ids = rng.integers(0, cfg.vocab_size, (global_bs, seq), dtype=np.int32)
+        labels = np.where(rng.random((global_bs, seq)) < 0.15, ids, -100)
+        return {"input_ids": ids,
+                "attention_mask": np.ones((global_bs, seq), np.int32),
+                "labels": labels.astype(np.int32)}
+
+    ds_config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Lamb", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True},
+    }
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)}, make_batch())["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, params=params,
+                                               config=ds_config)
+
+    batch = make_batch()
+    for _ in range(warmup):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    jax.block_until_ready(engine.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    jax.block_until_ready(engine.state.params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = global_bs * steps / dt
+    per_chip = samples_per_sec / n_chips
+    result = {
+        "metric": f"BERT-{'large' if on_tpu else 'tiny'} seq{seq} ZeRO-2 "
+                  f"pretrain throughput ({platform})",
+        "value": round(per_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

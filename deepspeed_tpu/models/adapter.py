@@ -29,7 +29,12 @@ def flax_module_loss_fn(module, params: Any = None,
         params = variables["params"]
 
     def loss_fn(p, batch, rng):
-        out = module.apply({"params": p}, batch, rngs={"dropout": rng})
+        # Convention: rng=None means evaluation — dropout off. The engine's
+        # eval path passes None (engine._eval_step).
+        if rng is None:
+            out = module.apply({"params": p}, batch, deterministic=True)
+        else:
+            out = module.apply({"params": p}, batch, rngs={"dropout": rng})
         if isinstance(out, dict):
             loss = out[loss_key]
             aux = {k: v for k, v in out.items() if k != loss_key}

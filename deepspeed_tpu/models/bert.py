@@ -1,0 +1,175 @@
+"""BERT family — the reference's headline pretraining benchmark target
+(BERT-Large, ``docs/_tutorials/bert-pretraining.md``; kernel-parity fixtures
+``tests/unit/modeling.py`` / ``modelingpreln.py``).
+
+Supports both post-LN (original BERT, reference ``modeling.py``) and pre-LN
+(reference ``modelingpreln.py``, the variant the fused kernel's
+``pre_layer_norm`` flag selects). MLM + NSP heads for pretraining parity.
+"""
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt import cross_entropy_with_ignore
+from deepspeed_tpu.ops.transformer.attention import attention
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "auto"
+    pre_layer_norm: bool = True     # reference fused-kernel default
+    remat: bool = False
+    layer_norm_epsilon: float = 1e-12
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+BERT_CONFIGS: Dict[str, BertConfig] = {
+    "tiny": BertConfig(vocab_size=512, max_seq_len=128, hidden_size=64,
+                       num_layers=2, num_heads=4, dropout_rate=0.0),
+    "bert-base": BertConfig(hidden_size=768, num_layers=12, num_heads=12),
+    "bert-large": BertConfig(hidden_size=1024, num_layers=24, num_heads=16),
+}
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask=None, deterministic: bool = True):
+        cfg = self.cfg
+        d, dt = cfg.hidden_size, cfg.dtype
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                                       dtype=jnp.float32, name=name)
+        drop_rng = (None if deterministic or cfg.dropout_rate == 0.0
+                    else self.make_rng("dropout"))
+
+        def attn(h):
+            qkv = nn.Dense(3 * d, dtype=dt, name="c_attn")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            b, s = q.shape[0], q.shape[1]
+            shape = (b, s, cfg.num_heads, cfg.head_dim)
+            o = attention(q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                          causal=False, mask=attn_mask,
+                          dropout_rate=cfg.dropout_rate, dropout_rng=drop_rng,
+                          deterministic=deterministic, impl=cfg.attention_impl)
+            o = nn.Dense(d, dtype=dt, name="c_proj")(o.reshape(b, s, d))
+            return nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(o)
+
+        def mlp(h):
+            h = nn.Dense(cfg.mlp_ratio * d, dtype=dt, name="c_fc")(h)
+            h = nn.gelu(h, approximate=True)
+            h = nn.Dense(d, dtype=dt, name="mlp_proj")(h)
+            return nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+
+        if cfg.pre_layer_norm:
+            x = x + attn(ln("ln_attn")(x).astype(dt))
+            x = x + mlp(ln("ln_mlp")(x).astype(dt))
+        else:  # post-LN original BERT
+            x = ln("ln_attn")(x + attn(x)).astype(dt)
+            x = ln("ln_mlp")(x + mlp(x)).astype(dt)
+        return x
+
+
+class BertModel(nn.Module):
+    """Pretraining model: encoder + MLM head (+ NSP when nsp labels given).
+
+    batch: {"input_ids" [B,S], "attention_mask" [B,S] (1=keep, optional),
+    "token_type_ids" (optional), "labels" (MLM, -100 = unmasked, optional),
+    "next_sentence_label" [B] (optional)}.
+    """
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, batch, deterministic: bool = False):
+        cfg = self.cfg
+        ids = batch["input_ids"]
+        b, s = ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+        tte = self.param("tte", nn.initializers.normal(0.02),
+                         (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
+        tt = batch.get("token_type_ids")
+        tt_emb = tte[tt] if tt is not None else tte[0][None, None]
+        x = (wte[ids] + wpe[:s][None] + tt_emb).astype(cfg.dtype)
+        if not cfg.pre_layer_norm:
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                             name="ln_emb")(x).astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
+
+        attn_mask = None
+        am = batch.get("attention_mask")
+        if am is not None:
+            attn_mask = am[:, None, None, :].astype(jnp.bool_)
+
+        layer = BertLayer
+        if cfg.remat:
+            layer = nn.remat(BertLayer, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = layer(cfg, name=f"layer_{i}")(x, attn_mask, deterministic)
+        if cfg.pre_layer_norm:
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                             name="ln_f")(x).astype(cfg.dtype)
+
+        # MLM head: transform + tied decoder (original BERT head shape).
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                         name="mlm_ln")(h)
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
+                              (cfg.vocab_size,), jnp.float32)
+        logits = jnp.einsum("bsd,vd->bsv", h.astype(cfg.dtype),
+                            wte.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32) + mlm_bias
+
+        out = {"logits": logits}
+        loss = jnp.float32(0.0)
+        labels = batch.get("labels")
+        if labels is not None:
+            loss = cross_entropy_with_ignore(logits, labels)
+        nsp = batch.get("next_sentence_label")
+        if nsp is not None:
+            pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                                       name="pooler")(x[:, 0]))
+            nsp_logits = nn.Dense(2, dtype=cfg.dtype, name="nsp_head")(pooled)
+            nsp_logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32))
+            loss = loss - jnp.mean(
+                jnp.take_along_axis(nsp_logp, nsp[:, None], axis=-1))
+            out["nsp_logits"] = nsp_logits
+        out["loss"] = loss
+        return out
+
+
+def bert_partition_rules() -> Tuple[Tuple[str, Tuple], ...]:
+    """Tensor-parallel rules — the shared block rules + BERT extras."""
+    from deepspeed_tpu.models.partition import transformer_block_rules
+
+    return transformer_block_rules() + (
+        (r".*(wpe|tte)$", (None, None)),
+    )
+
+
+def make_bert(name_or_cfg="tiny", **overrides) -> Tuple[BertModel, BertConfig]:
+    cfg = (BERT_CONFIGS[name_or_cfg] if isinstance(name_or_cfg, str)
+           else name_or_cfg)
+    if overrides:
+        cfg = replace(cfg, **overrides)
+    return BertModel(cfg), cfg

@@ -1,0 +1,189 @@
+"""GPT-2 family — flagship causal-LM models, TPU-first.
+
+The reference ships no model zoo of its own; its flagship benchmarks wrap
+Megatron GPT-2 (``tests/model/Megatron_GPT2``, ``docs/_tutorials/megatron.md``).
+Here the GPT family is in-tree flax so every subsystem (ZeRO, TP, pipeline,
+sequence parallel, kernels) has a first-class target.
+
+TPU-first choices:
+- combined QKV projection (one big [D, 3D] matmul for the MXU, the same
+  layout the reference's fused kernel uses via ``attn_qkvw``);
+- bf16 activations with fp32 LayerNorm/softmax;
+- attention goes through ``deepspeed_tpu.ops.transformer.attention`` so the
+  Pallas flash kernel is a config flag, not a model rewrite;
+- optional ``jax.checkpoint`` (remat) per block — activation checkpointing
+  (reference ``runtime/activation_checkpointing/checkpointing.py``) as a
+  model-level policy;
+- tensor-parallel PartitionSpecs provided by ``gpt_partition_rules()``:
+  attention/MLP weights split over the ``model`` axis Megatron-style
+  (column-parallel qkv/fc-in, row-parallel proj/fc-out).
+"""
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.attention import attention
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16          # activation/compute dtype
+    attention_impl: str = "auto"
+    remat: bool = False                 # activation checkpointing per block
+    tie_embeddings: bool = True
+    layer_norm_epsilon: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_params(self) -> int:
+        d, l, v = self.hidden_size, self.num_layers, self.vocab_size
+        per_layer = 12 * d * d + 13 * d
+        return v * d + self.max_seq_len * d + l * per_layer + 2 * d
+
+
+# Named configurations (sizes follow the public GPT-2 family).
+GPT_CONFIGS: Dict[str, GPTConfig] = {
+    "tiny": GPTConfig(vocab_size=512, max_seq_len=128, hidden_size=64,
+                      num_layers=2, num_heads=4, dropout_rate=0.0),
+    "gpt2": GPTConfig(hidden_size=768, num_layers=12, num_heads=12),
+    "gpt2-medium": GPTConfig(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt2-large": GPTConfig(hidden_size=1280, num_layers=36, num_heads=20),
+    "gpt2-xl": GPTConfig(hidden_size=1600, num_layers=48, num_heads=25),
+}
+
+
+class GPTBlock(nn.Module):
+    """Pre-LN transformer block (attention + MLP)."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask=None, deterministic: bool = True):
+        cfg = self.cfg
+        d = cfg.hidden_size
+        dt = cfg.dtype
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                         name="ln_1")(x).astype(dt)
+        qkv = nn.Dense(3 * d, dtype=dt, name="c_attn")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, s = q.shape[0], q.shape[1]
+        shape = (b, s, cfg.num_heads, cfg.head_dim)
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        drop_rng = (None if deterministic or cfg.dropout_rate == 0.0
+                    else self.make_rng("dropout"))
+        o = attention(q, k, v, causal=True, mask=attn_mask,
+                      dropout_rate=cfg.dropout_rate, dropout_rng=drop_rng,
+                      deterministic=deterministic, impl=cfg.attention_impl)
+        o = o.reshape(b, s, d)
+        o = nn.Dense(d, dtype=dt, name="c_proj")(o)
+        o = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(o)
+        x = x + o
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                         name="ln_2")(x).astype(dt)
+        h = nn.Dense(cfg.mlp_ratio * d, dtype=dt, name="c_fc")(h)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(d, dtype=dt, name="mlp_proj")(h)
+        h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+        return x + h
+
+
+class GPT(nn.Module):
+    """Causal LM. ``__call__(batch)`` returns {"loss", "logits"} so it plugs
+    straight into ``deepspeed_tpu.models.adapter.flax_module_loss_fn``.
+
+    batch: {"input_ids": [B,S] int32, optional "labels" (shifted internally if
+    absent), optional "attention_mask": [B,S] 1=keep}.
+    """
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, batch, deterministic: bool = False):
+        cfg = self.cfg
+        ids = batch["input_ids"]
+        b, s = ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+        x = wte[ids].astype(cfg.dtype) + wpe[:s][None].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
+
+        attn_mask = None
+        if "attention_mask" in batch and batch["attention_mask"] is not None:
+            am = batch["attention_mask"]          # [B, S] 1=keep
+            attn_mask = am[:, None, None, :].astype(jnp.bool_)
+
+        block = GPTBlock
+        if cfg.remat:
+            block = nn.remat(GPTBlock, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"h_{i}")(x, attn_mask, deterministic)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                         name="ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", x.astype(cfg.dtype),
+                                wte.astype(cfg.dtype),
+                                preferred_element_type=jnp.float32)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              name="lm_head")(x.astype(cfg.dtype)).astype(jnp.float32)
+
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+        loss = cross_entropy_with_ignore(logits, labels)
+        return {"loss": loss, "logits": logits}
+
+
+def cross_entropy_with_ignore(logits: jax.Array, labels: jax.Array,
+                              ignore_index: int = -100) -> jax.Array:
+    """Token-mean cross entropy, fp32, ignoring ``ignore_index`` positions."""
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel partition rules (Megatron-style column/row split)
+# ---------------------------------------------------------------------------
+
+def gpt_partition_rules() -> Tuple[Tuple[str, Tuple], ...]:
+    """(regex, spec-dims) pairs consumed by models.partition.build_specs —
+    the shared Megatron-style block rules plus GPT-specific extras. Mirrors
+    the reference's inference TP slicing (module_inject/replace_module.py:11).
+    """
+    from deepspeed_tpu.models.partition import transformer_block_rules
+
+    return transformer_block_rules() + (
+        (r".*wpe$", (None, None)),
+        (r".*lm_head/kernel$", (None, "model")),
+    )
+
+
+def make_gpt(name_or_cfg="tiny", **overrides) -> Tuple[GPT, GPTConfig]:
+    cfg = (GPT_CONFIGS[name_or_cfg] if isinstance(name_or_cfg, str)
+           else name_or_cfg)
+    if overrides:
+        cfg = replace(cfg, **overrides)
+    return GPT(cfg), cfg

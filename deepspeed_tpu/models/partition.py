@@ -1,0 +1,80 @@
+"""Rule-based tensor-parallel PartitionSpec construction.
+
+The reference shards weights for TP by per-architecture injection policies
+(``module_inject/replace_policy.py``, ``ReplaceWithTensorSlicing``
+``replace_module.py:11``). The TPU-native analogue is declarative: each model
+family publishes (regex → spec) rules over its param-tree paths; ``build_specs``
+walks any param pytree and emits the matching ``PartitionSpec`` tree, which the
+engine composes with ZeRO data-axis sharding (runtime/zero/partition.py).
+"""
+
+import re
+from typing import Any, Iterable, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec
+
+
+def transformer_block_rules() -> Tuple[Tuple[str, Optional[Tuple]], ...]:
+    """Megatron-style TP rules shared by every in-tree transformer family:
+    column-parallel qkv / fc-in (output dim on 'model'), row-parallel
+    proj / fc-out (input dim on 'model'), vocab-sharded embedding,
+    replicated LayerNorms. Families extend these with their own extras."""
+    return (
+        (r".*c_attn/kernel$", (None, "model")),
+        (r".*c_attn/bias$", ("model",)),
+        (r".*c_fc/kernel$", (None, "model")),
+        (r".*c_fc/bias$", ("model",)),
+        (r".*(c_proj|mlp_proj)/kernel$", ("model", None)),
+        (r".*(c_proj|mlp_proj)/bias$", (None,)),
+        (r".*wte$", ("model", None)),
+        (r".*ln_.*/(scale|bias)$", None),
+    )
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def build_specs(params: Any,
+                rules: Iterable[Tuple[str, Optional[Tuple]]],
+                default: Optional[Tuple] = None,
+                mesh_axes: Optional[dict] = None) -> Any:
+    """PartitionSpec pytree for ``params`` from (regex, dims) rules.
+
+    dims is a tuple like (None, 'model') naming the mesh axis per tensor dim
+    (or None for the whole rule → replicated). Axes of size 1 in ``mesh_axes``
+    are dropped to replicated so single-chip runs need no special-casing.
+    """
+    compiled = [(re.compile(pat), dims) for pat, dims in rules]
+
+    def axis_ok(axis_name):
+        if axis_name is None:
+            return True
+        if mesh_axes is None:
+            return True
+        return mesh_axes.get(axis_name, 1) > 1
+
+    def spec_for(path, leaf):
+        name = _path_str(path)
+        for pat, dims in compiled:
+            if pat.search(name):
+                if dims is None:
+                    return PartitionSpec()
+                dims = tuple(d if axis_ok(d) else None for d in dims)
+                dims = dims[:leaf.ndim] + (None,) * (leaf.ndim - len(dims))
+                return PartitionSpec(*dims)
+        if default is not None:
+            d = tuple(x if axis_ok(x) else None for x in default)
+            return PartitionSpec(*d[:leaf.ndim])
+        return PartitionSpec()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

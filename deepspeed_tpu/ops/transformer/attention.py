@@ -1,0 +1,115 @@
+"""Multi-head attention — the framework's central attention dispatch.
+
+TPU-native equivalent of the reference's fused attention kernels
+(``csrc/transformer/softmax_kernels.cu``, ``transform_kernels.cu``, and the
+strided-batch GEMMs inside ``ds_transformer_cuda.cpp:147``): on TPU the hot
+path is a Pallas flash-attention kernel (``deepspeed_tpu/ops/transformer/
+flash_attention.py``); the ``xla`` implementation is the always-correct
+reference that XLA fuses on its own and the numerics oracle for kernel-parity
+tests (the reference's ``tests/unit/test_cuda_forward.py`` methodology).
+
+All implementations share one signature over ``[batch, seq, heads, head_dim]``
+tensors. ``impl``:
+
+- ``"xla"``    — pure jnp einsum attention (softmax in fp32).
+- ``"pallas"`` — fused flash attention Pallas kernel (O(S) memory).
+- ``"auto"``   — pallas on TPU when shapes are tileable, else xla.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - no backend
+        return False
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                  causal: bool = False,
+                  bias: Optional[jax.Array] = None,
+                  mask: Optional[jax.Array] = None,
+                  dropout_rate: float = 0.0,
+                  dropout_rng: Optional[jax.Array] = None,
+                  deterministic: bool = True,
+                  softmax_scale: Optional[float] = None) -> jax.Array:
+    """Reference attention. q,k,v: [B, S, H, D] (k/v seq may differ from q's).
+
+    Softmax is computed in fp32 regardless of input dtype — the same
+    numerical-stability choice as the reference's ``attn_softmax`` kernel
+    (csrc/transformer/softmax_kernels.cu).
+    """
+    orig_dtype = q.dtype
+    head_dim = q.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (head_dim ** 0.5)
+    # [B, H, Sq, Sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    neg = jnp.finfo(jnp.float32).min
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        logits = jnp.where(causal_mask[None, None], logits, neg)
+    if mask is not None:
+        # mask: broadcastable to [B, H, Sq, Sk]; True/1 = attend.
+        while mask.ndim < 4:
+            mask = mask[:, None] if mask.ndim == 3 else mask[None]
+        logits = jnp.where(mask.astype(jnp.bool_), logits, neg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and not deterministic:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate>0 requires dropout_rng")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(orig_dtype), v)
+    return out
+
+
+def _pallas_ok(q, k, causal, bias, mask, dropout_rate, deterministic):
+    if bias is not None or mask is not None:
+        return False
+    if dropout_rate > 0.0 and not deterministic:
+        return False
+    sq, sk = q.shape[1], k.shape[1]
+    return (sq % 128 == 0 and sk % 128 == 0 and q.shape[-1] in
+            (64, 128, 256))
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+              causal: bool = False,
+              bias: Optional[jax.Array] = None,
+              mask: Optional[jax.Array] = None,
+              dropout_rate: float = 0.0,
+              dropout_rng: Optional[jax.Array] = None,
+              deterministic: bool = True,
+              softmax_scale: Optional[float] = None,
+              impl: str = "auto") -> jax.Array:
+    """Dispatching attention entry point used by every model family."""
+    if impl == "auto":
+        impl = ("pallas" if _on_tpu() and _pallas_ok(
+            q, k, causal, bias, mask, dropout_rate, deterministic) else "xla")
+    if impl == "pallas":
+        if bias is not None or mask is not None:
+            raise ValueError("impl='pallas' flash attention does not take "
+                             "mask/bias yet — use impl='xla' (or sparse "
+                             "attention for layout masks)")
+        if dropout_rate > 0.0 and not deterministic:
+            raise ValueError("impl='pallas' flash attention does not apply "
+                             "attention dropout — use impl='xla'")
+        from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal,
+                               softmax_scale=softmax_scale)
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal, bias=bias, mask=mask,
+                             dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                             deterministic=deterministic,
+                             softmax_scale=softmax_scale)
+    raise ValueError(f"unknown attention impl '{impl}'")

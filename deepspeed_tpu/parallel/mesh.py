@@ -46,8 +46,11 @@ def init_distributed(dist_backend: str = "xla",
     reference utils/distributed.py:54): our launcher exports
     DSTPU_COORDINATOR / DSTPU_NUM_PROCS / DSTPU_RANK.
     """
-    if jax.process_count() > 1:
-        return  # already initialised
+    # NB: must not touch jax.devices()/process_count() here — any backend
+    # query initialises the local runtime and jax.distributed.initialize
+    # would then be too late.
+    if jax.distributed.is_initialized():
+        return
     coordinator_address = coordinator_address or os.environ.get("DSTPU_COORDINATOR")
     if coordinator_address is None and "MASTER_ADDR" in os.environ:
         port = os.environ.get("MASTER_PORT", "29500")

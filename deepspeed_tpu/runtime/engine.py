@@ -173,7 +173,16 @@ class TPUEngine:
         # --- bookkeeping ----------------------------------------------------
         self.gradient_accumulation_steps = config.gradient_accumulation_steps
         self.train_micro_batch_size_per_gpu = config.train_micro_batch_size_per_gpu
-        self.train_batch_size = config.train_batch_size
+        # The config solved the batch triple against jax.device_count(); a
+        # custom mesh may dedicate devices to model/pipe/sequence axes, so
+        # the authoritative global batch derives from the mesh's dp size.
+        self.train_batch_size = (self.train_micro_batch_size_per_gpu *
+                                 self.gradient_accumulation_steps * self.dp_size)
+        if self.train_batch_size != config.train_batch_size:
+            log_dist(
+                f"train_batch_size recomputed for mesh dp={self.dp_size}: "
+                f"{config.train_batch_size} -> {self.train_batch_size}",
+                ranks=[0])
         self.steps_per_print = config.steps_per_print
         self.wall_clock_breakdown = config.wall_clock_breakdown
         self.timers = SynchronizedWallClockTimer()
@@ -219,10 +228,16 @@ class TPUEngine:
         mesh = self.mesh
 
         def shard_like(tree, specs):
-            return jax.tree_util.tree_map(
-                lambda x, s: jax.device_put(
-                    jnp.asarray(x, jnp.float32), NamedSharding(mesh, s)),
-                tree, specs)
+            # A jitted identity+cast always materialises NEW buffers; a bare
+            # device_put may alias the caller's arrays when the sharding
+            # already matches, and the step functions' donation would then
+            # delete the user's params out from under them.
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs)
+            return jax.jit(
+                lambda t: jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.float32), t),
+                out_shardings=shardings)(tree)
 
         with mesh:
             master = shard_like(params, self.param_specs)
@@ -338,7 +353,7 @@ class TPUEngine:
 
         def eval_step(state: TrainState, batch):
             compute_params = precision.cast_params(state.params)
-            out = loss_fn(compute_params, batch, state.rng)
+            out = loss_fn(compute_params, batch, None)  # rng=None ≡ eval mode
             loss, aux = (out if isinstance(out, tuple) else (out, None))
             return loss.astype(jnp.float32), aux
 
