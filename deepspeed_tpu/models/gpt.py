@@ -146,11 +146,18 @@ class GPT(nn.Module):
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               name="lm_head")(x.astype(cfg.dtype)).astype(jnp.float32)
 
-        labels = batch.get("labels")
-        if labels is None:
-            labels = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-        loss = cross_entropy_with_ignore(logits, labels)
+        loss = cross_entropy_with_ignore(logits, shift_labels(batch))
         return {"loss": loss, "logits": logits}
+
+
+def shift_labels(batch) -> jax.Array:
+    """Next-token labels: explicit ``labels`` or input_ids shifted left with
+    the trailing position ignored. Shared by the plain and pipeline heads."""
+    labels = batch.get("labels")
+    if labels is None:
+        ids = batch["input_ids"]
+        labels = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    return labels
 
 
 def cross_entropy_with_ignore(logits: jax.Array, labels: jax.Array,

@@ -1,0 +1,12 @@
+"""Pipeline parallelism: schedules, module contract, jitted pipeline engine."""
+
+from deepspeed_tpu.parallel.pipe.engine import PipelineEngine
+from deepspeed_tpu.parallel.pipe.module import (LayerSpec, PipeModel,
+                                                TiedLayerSpec, gpt_pipe_model)
+from deepspeed_tpu.parallel.pipe.pipeline import (pipeline_apply,
+                                                  pipeline_spec, stack_blocks)
+from deepspeed_tpu.parallel.pipe import schedule
+
+__all__ = ["PipelineEngine", "PipeModel", "LayerSpec", "TiedLayerSpec",
+           "gpt_pipe_model", "pipeline_apply", "pipeline_spec",
+           "stack_blocks", "schedule"]

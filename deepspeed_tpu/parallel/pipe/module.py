@@ -1,0 +1,139 @@
+"""Pipeline model description — the ``PipelineModule`` analogue.
+
+Reference (``deepspeed/runtime/pipe/module.py``): a layer list built from
+``LayerSpec``/``TiedLayerSpec`` (:25, :73), partitioned over stages by
+uniform/param-count/regex policies (:355), with tied-embedding comm groups.
+
+TPU-native contract (``PipeModel``): the pipelined segment must be a stack
+of structurally identical blocks (leading dim L sharded over ``pipe``);
+embedding + head are plain functions outside the pipeline, so weight tying
+is ordinary parameter sharing instead of a dedicated allreduce group.
+``LayerSpec`` is kept for API familiarity and for host-side stage
+assignment of *heterogeneous* inference pipelines (partition_uniform /
+partition_balanced, reference runtime/utils.py:342,:408 — in
+deepspeed_tpu.runtime.utils).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class LayerSpec:
+    """Delayed-build layer descriptor (reference pipe/module.py:25)."""
+
+    def __init__(self, typename, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer sharing weights with another layer by key (reference :73).
+    In the functional pipeline, tying is expressed by both layers reading
+    the same param subtree — record the key so builders can wire it."""
+
+    def __init__(self, key, typename, *args, forward_fn=None, **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+@dataclass
+class PipeModel:
+    """Functional pipeline model: loss = head(embed(batch) |> blocks).
+
+    - embed_fn(params, batch, rng)            -> activations [mb, ...]
+    - block_fn(one_block_params, activations) -> activations
+    - head_fn(params, activations, batch)     -> scalar loss
+    - params: {"embed": ..., "blocks": stacked [L, ...], "head": ...}
+
+    embed_fn/head_fn receive the FULL params dict, so weight tying (e.g.
+    the LM head reading params["embed"]["wte"]) is plain parameter sharing.
+    """
+
+    embed_fn: Callable
+    block_fn: Callable
+    head_fn: Callable
+    params: Any
+    num_blocks: int
+
+    def check(self, pipe_size: int) -> None:
+        if self.num_blocks % pipe_size:
+            raise ValueError(
+                f"{self.num_blocks} blocks not divisible by pipe={pipe_size}")
+
+
+def gpt_pipe_model(cfg, rng_key=None, example_batch=None) -> PipeModel:
+    """Build a PipeModel from the in-tree GPT family (models/gpt.py):
+    embedding + dropout outside, L GPTBlocks pipelined, ln_f + tied LM head
+    + cross-entropy outside."""
+    from deepspeed_tpu.models.gpt import (GPT, GPTBlock,
+                                          cross_entropy_with_ignore)
+
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+    if example_batch is None:
+        example_batch = {"input_ids": jnp.zeros((2, 16), jnp.int32)}
+
+    # Initialise through the reference model so shapes/naming match the
+    # non-pipelined family, then re-pack into the PipeModel layout.
+    model = GPT(cfg)
+    variables = model.init({"params": rng_key, "dropout": rng_key},
+                           example_batch)
+    flat = variables["params"]
+
+    block = GPTBlock(cfg)
+    from deepspeed_tpu.parallel.pipe.pipeline import stack_blocks
+
+    blocks = stack_blocks([flat[f"h_{i}"] for i in range(cfg.num_layers)])
+    params = {
+        "embed": {"wte": flat["wte"], "wpe": flat["wpe"]},
+        "blocks": blocks,
+        "head": {"ln_f": flat["ln_f"]},   # lm head tied to embed.wte
+    }
+
+    import flax.linen as nn
+
+    from deepspeed_tpu.models.gpt import shift_labels
+
+    def embed_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        s = ids.shape[1]
+        emb = params["embed"]
+        x = (emb["wte"][ids].astype(cfg.dtype) +
+             emb["wpe"][:s][None].astype(cfg.dtype))
+        if rng is not None and cfg.dropout_rate > 0.0:
+            keep = jax.random.bernoulli(rng, 1.0 - cfg.dropout_rate, x.shape)
+            x = jnp.where(keep, x / (1.0 - cfg.dropout_rate), 0.0)
+        return x
+
+    def block_fn(p, x, rng):
+        if rng is None or cfg.dropout_rate == 0.0:
+            return block.apply({"params": p}, x, None, True)
+        return block.apply({"params": p}, x, None, False,
+                           rngs={"dropout": rng})
+
+    # Final LN through flax's own LayerNorm (same impl/epsilon as the
+    # non-pipelined GPT's ln_f) + tied decode + shared label shift, so the
+    # two loss paths cannot drift.
+    ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32)
+
+    def head_fn(params, x, batch):
+        h = ln_f.apply({"params": params["head"]["ln_f"]}, x)
+        logits = jnp.einsum("bsd,vd->bsv", h.astype(cfg.dtype),
+                            params["embed"]["wte"].astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        return cross_entropy_with_ignore(logits, shift_labels(batch))
+
+    return PipeModel(embed_fn=embed_fn, block_fn=block_fn,
+                     head_fn=head_fn, params=params,
+                     num_blocks=cfg.num_layers)

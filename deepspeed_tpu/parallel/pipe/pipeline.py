@@ -1,0 +1,152 @@
+"""Pipelined execution over the ``pipe`` mesh axis — TPU-native.
+
+The reference drives pipeline parallelism from the host: a Python scheduler
+(`pipe/schedule.py`) dispatches per-tick instructions whose Send/Recv are
+NCCL broadcasts between adjacent ranks (`pipe/engine.py:1209`,
+`pipe/p2p.py:31`). On TPU that design would serialise dispatch; instead the
+WHOLE pipelined step is one jitted program: a ``shard_map`` manual over the
+``pipe`` axis ONLY (`axis_names={'pipe'}`) runs every stage in SPMD, a
+``lax.scan`` over schedule ticks moves microbatch activations between
+neighbouring stages with ``lax.ppermute`` over ICI, and reverse-mode AD of
+that scan yields the backward pipeline automatically (ppermute transposes
+to the reverse shift) — the moral equivalent of the 1F1B instruction tape,
+scheduled by XLA. Because ``data``/``model``/``sequence`` stay AUTO axes,
+ZeRO data-sharding and Megatron tensor parallelism inside each block keep
+working through GSPMD — the pp × tp × dp composition of the reference's 3D
+topology (pipe/topology.py:246) without hand-built process groups.
+
+Model layout contract (the ``PipelineModule`` analogue, pipe/module.py:87):
+embedding and loss head live OUTSIDE the pipelined segment (computed under
+plain GSPMD, which also ties input/output embeddings for free — the
+reference needs TiedLayerSpec + a dedicated allreduce group for this,
+module.py:73); the pipelined body is a stack of L structurally identical
+blocks, stacked on a leading dim that is sharded over ``pipe`` so each
+stage owns L/S consecutive blocks.
+"""
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
+
+
+def stack_blocks(block_params_list):
+    """Stack per-block param pytrees into one pytree with leading dim L."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *block_params_list)
+
+
+def pipeline_spec(blocks_params) -> Any:
+    """PartitionSpec tree sharding the stacked block dim over ``pipe``."""
+    return jax.tree_util.tree_map(
+        lambda x: P(PIPE_AXIS, *([None] * (x.ndim - 1))), blocks_params)
+
+
+def pipeline_apply(block_fn: Callable,
+                   blocks_params: Any,
+                   x: jax.Array,
+                   mesh: Mesh,
+                   *,
+                   rng: Optional[jax.Array] = None,
+                   num_microbatches: Optional[int] = None,
+                   remat_blocks: bool = True) -> jax.Array:
+    """Run the stacked-block pipeline over microbatches.
+
+    block_fn(params_one_block, x, rng_or_None) -> x  (one transformer block)
+    blocks_params: pytree, leaves [L, ...] — L % pipe_size == 0
+    x: [M, mb, ...] microbatched activations (M = num_microbatches)
+    rng: PRNG key for per-block dropout (None ≡ deterministic)
+
+    Returns [M, mb, ...] last-stage outputs. With pipe_size == 1 this
+    degenerates to a scan over blocks (no collectives emitted). Only the
+    ``pipe`` axis is manual in the shard_map — tensor-parallel specs on the
+    block params and data sharding on the batch keep working via GSPMD.
+    """
+    stages = mesh.shape.get(PIPE_AXIS, 1)
+    L = jax.tree_util.tree_leaves(blocks_params)[0].shape[0]
+    if L % stages:
+        raise ValueError(f"{L} blocks not divisible by {stages} pipeline stages")
+    M = num_microbatches if num_microbatches is not None else x.shape[0]
+    if x.shape[0] != M:
+        raise ValueError(f"x has {x.shape[0]} microbatches, expected {M}")
+
+    fn = block_fn
+    if remat_blocks:
+        fn = jax.checkpoint(block_fn)
+
+    def stage_apply(stage_blocks, h, key):
+        # Apply this stage's L/S blocks in order (scan keeps the program
+        # small; blocks are structurally identical by contract).
+        def body(h, xs):
+            p, i = xs
+            k = None if key is None else jax.random.fold_in(key, i)
+            return fn(p, h, k), None
+
+        n = jax.tree_util.tree_leaves(stage_blocks)[0].shape[0]
+        h, _ = jax.lax.scan(body, h, (stage_blocks, jnp.arange(n)))
+        return h
+
+    if stages == 1:
+        def per_mb(mb, i):
+            key = None if rng is None else jax.random.fold_in(rng, i)
+            return stage_apply(blocks_params, mb, key)
+
+        return jax.vmap(per_mb)(x, jnp.arange(M))
+
+    T = M + stages - 1
+
+    compute_dtype = x.dtype
+
+    def pipelined(stage_blocks, x_all, *key):
+        # stage_blocks leaves: [L/S, ...] (pipe dim stripped; other axes
+        # remain GSPMD-auto); x_all: [M, mb, ...] replicated across pipe.
+        # x crosses the shard_map boundary in fp32 (see psum note below:
+        # the cotangent of a pipe-replicated input is a psum, which must
+        # not run in bf16 under a partial-manual shard_map).
+        x_all = x_all.astype(compute_dtype)
+        keys = key[0] if key else None
+        rank = jax.lax.axis_index(PIPE_AXIS)
+        shift = [(i, (i + 1) % stages) for i in range(stages)]
+
+        def tick(carry, t):
+            buf = carry
+            inject = jax.lax.dynamic_index_in_dim(
+                x_all, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+            h = jnp.where(rank == 0, inject, buf)
+            k = (None if keys is None
+                 else jax.random.fold_in(jax.random.fold_in(keys, t), rank))
+            y = stage_apply(stage_blocks, h, k)
+            buf = jax.lax.ppermute(y, PIPE_AXIS, shift)
+            return buf, y
+
+        _, ys = jax.lax.scan(tick, jnp.zeros_like(x_all[0]),
+                             jnp.arange(T))
+        # Last stage produced microbatch m at tick m + S - 1.
+        out = jax.lax.dynamic_slice_in_dim(ys, stages - 1, M, axis=0)
+        # Hand the result to every pipe rank (the reference broadcasts the
+        # final-stage loss similarly, pipe/engine.py:453); activations of
+        # non-final stages are discarded by the where. The psum runs in fp32:
+        # a bf16 all-reduce under a partial-manual shard_map crashes the XLA
+        # CPU backend ("Invalid binary instruction opcode copy"), and fp32
+        # summation is the numerically safer choice anyway.
+        masked = jnp.where(rank == stages - 1, out,
+                           jnp.zeros_like(out)).astype(jnp.float32)
+        return jax.lax.psum(masked, PIPE_AXIS).astype(out.dtype)
+
+    args = (blocks_params, x.astype(jnp.float32)) + \
+        (() if rng is None else (rng,))
+    in_specs = (pipeline_spec(blocks_params), P()) + \
+        (() if rng is None else (P(),))
+    return shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )(*args)

@@ -1,0 +1,229 @@
+"""Pipeline instruction schedules — pure-Python generators.
+
+Functional port of the reference's backend-agnostic schedule layer
+(``deepspeed/runtime/pipe/schedule.py``): a schedule yields, per engine
+"step", the list of instructions a given stage executes. The reference's
+``TrainSchedule`` (:182) interleaves forward/backward by step parity (1F1B
+with alternating even/odd ticks); ``InferenceSchedule`` (:129) is
+forward-only; ``DataParallelSchedule`` (:292) degenerates to pure DP.
+
+On TPU the hot path executes the whole pipeline inside one jitted shard_map
+program (``pipeline.py``) — XLA schedules the real overlap — but these
+generators remain the source of truth for (a) host-driven execution and
+microbatch accounting, (b) schedule unit tests (reference
+tests/unit/test_pipe_schedule.py), and (c) bubble/utilisation analysis.
+"""
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    """Base instruction. kwargs become attributes (reference schedule.py:317)."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        if not self.kwargs:
+            return self.name
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)) and self.kwargs == other.kwargs
+
+    def __hash__(self):
+        return hash((self.name, tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class ForwardPass(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class BackwardPass(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class SendActivation(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class RecvActivation(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class SendGrad(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class RecvGrad(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class PipeSchedule:
+    """Iterable of per-step instruction lists for one stage
+    (reference schedule.py:12)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        if not 0 <= stage_id < stages:
+            raise ValueError(f"stage_id {stage_id} out of range [0,{stages})")
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, mb: int) -> bool:
+        return 0 <= mb < self.micro_batches
+
+    def _valid_stage(self, s: int) -> bool:
+        return 0 <= s < self.stages
+
+    def __iter__(self):
+        return self.steps()
+
+    def __len__(self):
+        return sum(1 for _ in self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelining (reference schedule.py:129)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            cmds = []
+            micro_batch_id = step_id - self.stage_id
+            if self._valid_micro_batch(micro_batch_id):
+                buf = micro_batch_id % self.num_pipe_buffers()
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=buf))
+                else:
+                    cmds.append(RecvActivation(buffer_id=buf))
+                cmds.append(ForwardPass(buffer_id=buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=buf))
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return min(2, self.micro_batches)
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B-by-parity training schedule (semantics of reference
+    schedule.py:182): 2*(M+S-1) ticks. Stage s runs the forward of
+    microbatch m at tick ``2m + s`` (its parity ticks) and the backward at
+    tick ``2m + 2S - s - 1`` (opposite parity), so steady-state alternates
+    one-forward-one-backward and backward of m at stage s follows backward
+    at stage s+1 by exactly one tick. Transfers are emitted one tick after
+    the producing compute; ends with grad reduction + optimizer step."""
+
+    def steps(self):
+        S = self.stages
+        s = self.stage_id
+        total_steps = 2 * (self.micro_batches + S - 1)
+        for t in range(total_steps):
+            cmds = []
+
+            # Ship results produced last tick.
+            if self._valid_stage(self.next_stage):
+                m = (t - 1 - s)
+                if m % 2 == 0 and self._valid_micro_batch(m // 2):
+                    cmds.append(SendActivation(
+                        buffer_id=self._buffer_idx(m // 2)))
+            if self._valid_stage(self.prev_stage):
+                m = (t - (2 * S - s - 1) - 1)
+                if m % 2 == 0 and self._valid_micro_batch(m // 2):
+                    cmds.append(SendGrad(buffer_id=self._buffer_idx(m // 2)))
+
+            # This tick's compute (+ its ingest).
+            mf = (t - s)
+            if mf % 2 == 0 and self._valid_micro_batch(mf // 2):
+                buf = self._buffer_idx(mf // 2)
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=buf))
+                else:
+                    cmds.append(RecvActivation(buffer_id=buf))
+                cmds.append(ForwardPass(buffer_id=buf))
+            mb = (t - (2 * S - s - 1))
+            if mb % 2 == 0 and self._valid_micro_batch(mb // 2):
+                buf = self._buffer_idx(mb // 2)
+                if self._valid_stage(self.next_stage):
+                    cmds.append(RecvGrad(buffer_id=buf))
+                cmds.append(BackwardPass(buffer_id=buf))
+
+            if t == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            yield cmds
+
+    def num_pipe_buffers(self):
+        """Max outstanding microbatches for this stage (reference :277):
+        earlier stages hold more in-flight forwards."""
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule ≡ plain DP (reference :292)."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(buffer_id=0), ForwardPass(buffer_id=0),
+                    BackwardPass(buffer_id=0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 1
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0
+
+
+def _is_odd(x: int) -> bool:
+    return x % 2 != 0
+
+
+def bubble_fraction(micro_batches: int, stages: int) -> float:
+    """Pipeline bubble overhead (S-1)/(M+S-1) — utilisation analysis."""
+    return (stages - 1) / (micro_batches + stages - 1)

@@ -281,14 +281,48 @@ class TPUEngine:
     # ------------------------------------------------------------------
     # jitted step construction
     # ------------------------------------------------------------------
-    def _build_step_fns(self) -> None:
+    def _make_apply_step(self):
+        """GAS-boundary optimizer apply: unscale → overflow check → clip →
+        update → loss-scale update → overflow-skip (≡ reference
+        _take_model_step engine.py:1253 + stage2.step :1471). Shared by the
+        plain and pipeline engines."""
         cfg = self.config
-        gas = cfg.gradient_accumulation_steps
         fp16 = cfg.fp16.enabled
         clip = cfg.gradient_clipping
         predivide = cfg.prescale_gradients
         optimizer = self.optimizer
         scaler = self.loss_scaler
+
+        def apply_step(state: TrainState, lr):
+            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
+            inv = 1.0 / scale
+            if predivide:
+                inv = inv * self.dp_size / cfg.gradient_predivide_factor
+            grads = jax.tree_util.tree_map(lambda g: g * inv, state.grad_acc)
+            overflow = has_inf_or_nan(grads) if fp16 else jnp.zeros((), jnp.bool_)
+            norm = global_norm(grads)
+            if clip > 0.0:
+                grads = clip_grad_by_global_norm(grads, clip, norm=norm)
+            new_params, new_opt = optimizer.update(grads, state.opt_state,
+                                                   state.params, lr=lr)
+            new_params = _tree_where(overflow, state.params, new_params)
+            new_opt = _tree_where(overflow, state.opt_state, new_opt)
+            new_ls = scaler.update(state.loss_scale, overflow)
+            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
+            return state._replace(
+                step=state.step + jnp.where(overflow, 0, 1),
+                params=new_params, opt_state=new_opt, grad_acc=zero_acc,
+                loss_scale=new_ls,
+                skipped_steps=state.skipped_steps + overflow.astype(jnp.int32),
+            ), overflow, norm
+
+        return apply_step
+
+    def _build_step_fns(self) -> None:
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        fp16 = cfg.fp16.enabled
+        predivide = cfg.prescale_gradients
         precision = self.precision
         loss_fn = self.loss_fn
         mesh = self.mesh
@@ -317,28 +351,7 @@ class TPUEngine:
             return state._replace(micro_step=state.micro_step + 1,
                                   grad_acc=grads, rng=rng), loss, aux
 
-        def apply_step(state: TrainState, lr):
-            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
-            inv = 1.0 / scale
-            if predivide:
-                inv = inv * self.dp_size / cfg.gradient_predivide_factor
-            grads = jax.tree_util.tree_map(lambda g: g * inv, state.grad_acc)
-            overflow = has_inf_or_nan(grads) if fp16 else jnp.zeros((), jnp.bool_)
-            norm = global_norm(grads)
-            if clip > 0.0:
-                grads = clip_grad_by_global_norm(grads, clip, norm=norm)
-            new_params, new_opt = optimizer.update(grads, state.opt_state,
-                                                   state.params, lr=lr)
-            new_params = _tree_where(overflow, state.params, new_params)
-            new_opt = _tree_where(overflow, state.opt_state, new_opt)
-            new_ls = scaler.update(state.loss_scale, overflow)
-            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
-            return state._replace(
-                step=state.step + jnp.where(overflow, 0, 1),
-                params=new_params, opt_state=new_opt, grad_acc=zero_acc,
-                loss_scale=new_ls,
-                skipped_steps=state.skipped_steps + overflow.astype(jnp.int32),
-            ), overflow, norm
+        apply_step = self._make_apply_step()
 
         def train_step(state: TrainState, batches, lr):
             """Fused GAS loop: batches have leading dim == gas."""

@@ -1,0 +1,143 @@
+"""Pipeline schedule unit tests — pure Python, no devices (the analogue of
+reference tests/unit/test_pipe_schedule.py): completeness, causality, and
+1F1B interleaving properties over a grid of (microbatches, stages)."""
+
+import itertools
+
+import pytest
+
+from deepspeed_tpu.parallel.pipe import schedule as sched
+
+
+GRID = [(m, s) for m, s in itertools.product([1, 2, 4, 8], [1, 2, 3, 4])
+        if m >= 1 and s >= 1]
+
+
+def _collect(schedule_cls, micro_batches, stages):
+    """Returns {stage: [(tick, instr), ...]}."""
+    out = {}
+    for stage in range(stages):
+        sch = schedule_cls(micro_batches=micro_batches, stages=stages,
+                           stage_id=stage)
+        out[stage] = [(t, instr) for t, cmds in enumerate(sch.steps())
+                      for instr in cmds]
+    return out
+
+
+def _ticks_of(events, kind, stage):
+    return {instr.buffer_id if hasattr(instr, "buffer_id") else None: t
+            for t, instr in events[stage] if isinstance(instr, kind)}
+
+
+class TestTrainSchedule:
+    @pytest.mark.parametrize("m,s", GRID)
+    def test_each_microbatch_forward_and_backward_once(self, m, s):
+        events = _collect(sched.TrainSchedule, m, s)
+        for stage in range(s):
+            fwd = [i for _, i in events[stage]
+                   if isinstance(i, sched.ForwardPass)]
+            bwd = [i for _, i in events[stage]
+                   if isinstance(i, sched.BackwardPass)]
+            assert len(fwd) == m, f"stage {stage}: {len(fwd)} forwards"
+            assert len(bwd) == m, f"stage {stage}: {len(bwd)} backwards"
+
+    @pytest.mark.parametrize("m,s", GRID)
+    def test_causality(self, m, s):
+        """fwd(mb, s) < fwd(mb, s+1); bwd(mb, s+1) < bwd(mb, s);
+        fwd(mb, s) < bwd(mb, s)."""
+        # Track by microbatch order of ForwardPass/BackwardPass appearance:
+        # buffer ids recycle, so reconstruct microbatch ids by order.
+        for stage in range(s):
+            sch = sched.TrainSchedule(micro_batches=m, stages=s,
+                                      stage_id=stage)
+            fwd_ticks, bwd_ticks = [], []
+            for t, cmds in enumerate(sch.steps()):
+                for i in cmds:
+                    if isinstance(i, sched.ForwardPass):
+                        fwd_ticks.append(t)
+                    elif isinstance(i, sched.BackwardPass):
+                        bwd_ticks.append(t)
+            # forwards and backwards are in microbatch order per stage
+            assert fwd_ticks == sorted(fwd_ticks)
+            assert bwd_ticks == sorted(bwd_ticks)
+            for mb in range(m):
+                assert fwd_ticks[mb] < bwd_ticks[mb]
+            if stage > 0:
+                prev = sched.TrainSchedule(micro_batches=m, stages=s,
+                                           stage_id=stage - 1)
+                prev_fwd = [t for t, cmds in enumerate(prev.steps())
+                            for i in cmds if isinstance(i, sched.ForwardPass)]
+                prev_bwd = [t for t, cmds in enumerate(prev.steps())
+                            for i in cmds if isinstance(i, sched.BackwardPass)]
+                for mb in range(m):
+                    assert prev_fwd[mb] < fwd_ticks[mb]
+                    assert bwd_ticks[mb] < prev_bwd[mb]
+
+    @pytest.mark.parametrize("m,s", GRID)
+    def test_sends_match_recvs(self, m, s):
+        events = _collect(sched.TrainSchedule, m, s)
+        for stage in range(s - 1):
+            sends = sum(isinstance(i, sched.SendActivation)
+                        for _, i in events[stage])
+            recvs = sum(isinstance(i, sched.RecvActivation)
+                        for _, i in events[stage + 1])
+            assert sends == recvs == m
+            gsends = sum(isinstance(i, sched.SendGrad)
+                         for _, i in events[stage + 1])
+            grecvs = sum(isinstance(i, sched.RecvGrad)
+                         for _, i in events[stage])
+            assert gsends == grecvs == m
+
+    def test_terminates_with_step(self):
+        sch = sched.TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+        steps = list(sch.steps())
+        assert sched.OptimizerStep() in steps[-1]
+        assert sched.ReduceGrads() in steps[-1]
+        assert len(steps) == 2 * (4 + 2 - 1)
+
+    def test_first_stage_loads_microbatches(self):
+        sch = sched.TrainSchedule(micro_batches=3, stages=2, stage_id=0)
+        loads = [i for cmds in sch.steps() for i in cmds
+                 if isinstance(i, sched.LoadMicroBatch)]
+        assert len(loads) == 3
+
+    def test_steady_state_interleaves_1f1b(self):
+        """With plenty of microbatches, mid-schedule ticks alternate
+        fwd/bwd on every stage (the 1F1B property)."""
+        m, s = 8, 4
+        for stage in range(s):
+            sch = sched.TrainSchedule(micro_batches=m, stages=s,
+                                      stage_id=stage)
+            kinds = []
+            for cmds in sch.steps():
+                for i in cmds:
+                    if isinstance(i, (sched.ForwardPass, sched.BackwardPass)):
+                        kinds.append(type(i).__name__)
+            middle = kinds[s:-s] if s else kinds
+            for a, b in zip(middle, middle[1:]):
+                assert a != b, f"stage {stage} not interleaved: {kinds}"
+
+
+class TestInferenceSchedule:
+    @pytest.mark.parametrize("m,s", GRID)
+    def test_forward_only_complete(self, m, s):
+        events = _collect(sched.InferenceSchedule, m, s)
+        for stage in range(s):
+            fwd = [i for _, i in events[stage]
+                   if isinstance(i, sched.ForwardPass)]
+            assert len(fwd) == m
+            assert not any(isinstance(i, sched.BackwardPass)
+                           for _, i in events[stage])
+
+
+class TestDataParallelSchedule:
+    def test_degenerate(self):
+        sch = sched.DataParallelSchedule(micro_batches=3, stages=1, stage_id=0)
+        steps = list(sch.steps())
+        assert len(steps) == 3
+        assert sched.OptimizerStep() in steps[-1]
+
+
+def test_bubble_fraction():
+    assert sched.bubble_fraction(8, 1) == 0.0
+    assert abs(sched.bubble_fraction(8, 4) - 3 / 11) < 1e-9
