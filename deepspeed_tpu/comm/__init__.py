@@ -1,0 +1,9 @@
+"""Communication backends: named collectives + compressed (1-bit) allreduce."""
+
+from deepspeed_tpu.comm import collectives
+from deepspeed_tpu.comm.compressed import (compressed_allreduce,
+                                           compressed_allreduce_local,
+                                           pack_signs, unpack_signs)
+
+__all__ = ["collectives", "compressed_allreduce",
+           "compressed_allreduce_local", "pack_signs", "unpack_signs"]

@@ -1,0 +1,70 @@
+"""Named collective wrappers — the torch.distributed surface, TPU-native.
+
+The reference calls ``torch.distributed`` {all_reduce, reduce, all_gather,
+all_to_all_single, broadcast, barrier} over NCCL groups (SURVEY.md §2.5).
+On TPU the same verbs are XLA collectives over named mesh axes, legal inside
+``shard_map`` manual regions; these wrappers fix the naming and the couple
+of non-obvious encodings (broadcast as a masked psum, barrier as a token
+psum). Outside shard_map, prefer plain sharding annotations — GSPMD inserts
+collectives itself; this module is for the manual paths (pipeline, ring,
+compressed comm) and for API familiarity.
+"""
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def all_reduce(x: jax.Array, axis: str, op: str = "sum") -> jax.Array:
+    if op == "sum":
+        return jax.lax.psum(x, axis)
+    if op == "mean":
+        return jax.lax.pmean(x, axis)
+    if op == "max":
+        return jax.lax.pmax(x, axis)
+    if op == "min":
+        return jax.lax.pmin(x, axis)
+    raise ValueError(f"unknown reduce op '{op}'")
+
+
+def all_gather(x: jax.Array, axis: str, *, tiled: bool = True,
+               gather_dim: int = 0) -> jax.Array:
+    return jax.lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x: jax.Array, axis: str, *, scatter_dim: int = 0) -> jax.Array:
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                                tiled=True)
+
+
+def all_to_all(x: jax.Array, axis: str, *, split_dim: int,
+               concat_dim: int) -> jax.Array:
+    return jax.lax.all_to_all(x, axis, split_axis=split_dim,
+                              concat_axis=concat_dim, tiled=True)
+
+
+def broadcast(x: jax.Array, axis: str, root: int = 0) -> jax.Array:
+    """Every rank gets root's value — masked psum (the same trick the
+    reference uses for pipeline p2p, pipe/p2p.py:31)."""
+    rank = jax.lax.axis_index(axis)
+    masked = jnp.where(rank == root, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked.astype(jnp.float32), axis).astype(x.dtype)
+
+
+def ppermute(x: jax.Array, axis: str, perm: Sequence) -> jax.Array:
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def send_recv_next(x: jax.Array, axis: str, n: int) -> jax.Array:
+    """Shift to the next rank on the ring (pipeline activation transfer)."""
+    return jax.lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+
+
+def send_recv_prev(x: jax.Array, axis: str, n: int) -> jax.Array:
+    return jax.lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
+
+
+def barrier(axis: str) -> jax.Array:
+    """Synchronisation token: a collective nothing."""
+    return jax.lax.psum(jnp.ones((), jnp.int32), axis)

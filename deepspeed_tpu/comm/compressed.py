@@ -1,0 +1,112 @@
+"""Error-compensated 1-bit compressed allreduce — TPU-native.
+
+Re-design of the reference's ``NcclBackend.compressed_allreduce``
+(``deepspeed/runtime/comm/nccl.py:47``): sign-compress the compensated
+tensor to 1 bit/element (packed 8-per-uint8 — the CuPy ``packbits`` role,
+``runtime/compression/cupy.py``), all_to_all the packed chunks so each rank
+server-averages one chunk of the tensor, re-compress the average with
+server-side error feedback, and all_gather the result. Wire volume per rank
+≈ 2 × numel/8 bytes + scales, vs 2 × numel × 4 for fp32 ring allreduce —
+the raison d'être is slow DCN links between pod slices.
+
+Runs inside a shard_map manual over one mesh axis (default ``data``); the
+packing is plain jnp (a reshape + matmul with powers of two) which XLA
+vectorises on the VPU — no custom kernel needed.
+
+Error feedback: both worker and server errors are carried by the caller
+(the 1-bit optimizers store them as optimizer state), making the op pure.
+"""
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import DATA_AXIS
+
+_POW2 = 2 ** jnp.arange(8, dtype=jnp.uint8)
+
+
+def pack_signs(bits: jax.Array) -> jax.Array:
+    """bool[..., 8k] -> uint8[..., k]: 8 sign bits per byte."""
+    *lead, n = bits.shape
+    assert n % 8 == 0, f"pack length {n} not a multiple of 8"
+    grouped = bits.reshape(*lead, n // 8, 8).astype(jnp.uint8)
+    return jnp.sum(grouped * _POW2, axis=-1, dtype=jnp.uint8)
+
+
+def unpack_signs(packed: jax.Array, n: int) -> jax.Array:
+    """uint8[..., k] -> float[..., 8k] of ±1."""
+    bits = (packed[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    bits = bits.reshape(*packed.shape[:-1], -1)[..., :n]
+    return bits.astype(jnp.float32) * 2.0 - 1.0
+
+
+def _compress(x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """-> (packed uint8, scale, decompressed). Scale = mean|x| preserves the
+    l1 norm under sign compression (the reference's scale choice)."""
+    scale = jnp.mean(jnp.abs(x), axis=-1, keepdims=True)
+    bits = x >= 0
+    decompressed = (bits.astype(jnp.float32) * 2.0 - 1.0) * scale
+    return pack_signs(bits), scale, decompressed
+
+
+def compressed_allreduce_local(x: jax.Array,
+                               worker_error: jax.Array,
+                               server_error: jax.Array,
+                               axis: str,
+                               n: int):
+    """The manual-region body: x is this rank's LOCAL tensor [numel]
+    (numel % (8*n) == 0). Returns (averaged [numel], new_worker_error,
+    new_server_error [numel/n])."""
+    numel = x.shape[0]
+    chunk = numel // n
+
+    # -- worker phase: compensate, compress, ship chunks -------------------
+    compensated = x + worker_error
+    chunks = compensated.reshape(n, chunk)
+    packed, scales, decompressed = _compress(chunks)      # [n, chunk/8],[n,1]
+    new_worker_error = compensated - decompressed.reshape(numel)
+    # all_to_all: rank r receives every rank's r-th chunk.
+    recv_packed = jax.lax.all_to_all(packed, axis, split_axis=0,
+                                     concat_axis=0, tiled=False)
+    recv_scales = jax.lax.all_to_all(scales, axis, split_axis=0,
+                                     concat_axis=0, tiled=False)
+    # -- server phase: average my chunk across workers, re-compress --------
+    signs = unpack_signs(recv_packed, chunk)              # [n, chunk] ±1
+    avg = jnp.mean(signs * recv_scales, axis=0)           # [chunk]
+    served = avg + server_error
+    s_packed, s_scale, s_decompressed = _compress(served[None])
+    new_server_error = served - s_decompressed[0]
+    # -- gather the served chunks back to everyone -------------------------
+    all_packed = jax.lax.all_gather(s_packed, axis, axis=0)   # [n,1,chunk/8]
+    all_scales = jax.lax.all_gather(s_scale, axis, axis=0)    # [n,1,1]
+    result = (unpack_signs(all_packed[:, 0], chunk) *
+              all_scales[:, 0]).reshape(numel)
+    return result, new_worker_error, new_server_error
+
+
+def compressed_allreduce(x: jax.Array,
+                         worker_error: jax.Array,
+                         server_error: jax.Array,
+                         mesh: Mesh,
+                         axis: str = DATA_AXIS):
+    """jit-level entry for tests/benchmarks: ``x`` [n, numel] carries each
+    rank's local tensor on the leading (sharded) dim."""
+    n = mesh.shape.get(axis, 1)
+    body = functools.partial(compressed_allreduce_local, axis=axis, n=n)
+
+    def fn(x_l, we_l, se_l):
+        out, we, se = body(x_l[0], we_l[0], se_l[0])
+        return out[None], we[None], se[None]
+
+    mapped = shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis)),
+        axis_names={axis},
+        check_vma=False)
+    return jax.jit(mapped)(x, worker_error, server_error)

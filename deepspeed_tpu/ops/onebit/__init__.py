@@ -1,0 +1,6 @@
+"""Communication-efficient 1-bit optimizers."""
+
+from deepspeed_tpu.ops.onebit.adam import OneBitAdam, OneBitState
+from deepspeed_tpu.ops.onebit.lamb import OneBitLamb
+
+__all__ = ["OneBitAdam", "OneBitLamb", "OneBitState"]

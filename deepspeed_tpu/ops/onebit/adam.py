@@ -1,0 +1,151 @@
+"""1-bit Adam — communication-efficient Adam (reference
+``deepspeed/runtime/fp16/onebit/adam.py:14``).
+
+Two phases, as in the reference:
+- **warmup** (step < freeze_step): ordinary Adam on densely-averaged
+  gradients — variance and momentum both update.
+- **compressed** (step >= freeze_step): the variance is FROZEN; the
+  *momentum* is synchronised with the error-compensated 1-bit collective
+  (comm/compressed.py) instead of any dense gradient allreduce.
+
+Engine contract: this optimizer sets ``needs_local_grads = True`` — the
+engine then runs the whole update inside a shard_map manual over ``data``
+and hands it this rank's LOCAL (unreduced) gradients; during warmup the
+optimizer densely ``pmean``s them itself. Params/moments are replicated
+across data (ZeRO-0; the reference similarly bypasses ZeRO here).
+
+State layout: moments per param; error feedback buffers per param in a
+flat, 8·n-aligned representation.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.comm.compressed import compressed_allreduce_local
+from deepspeed_tpu.parallel.mesh import DATA_AXIS
+
+
+class OneBitState(NamedTuple):
+    step: jax.Array
+    m: Any              # first moment (per-param tree)
+    v: Any              # second moment (frozen after warmup)
+    worker_error: Any   # flat error-feedback per param [padded numel]
+    server_error: Any   # flat server error per param [padded numel / n]
+
+
+def _pad_len(numel: int, n: int) -> int:
+    align = 8 * n
+    return (numel + align - 1) // align * align
+
+
+class OneBitAdam:
+    """Functional optimizer. ``update`` must run inside a data-manual
+    shard_map (the engine arranges this when ``needs_local_grads``)."""
+
+    needs_local_grads = True
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, freeze_step: int = 100,
+                 mesh=None, axis: str = DATA_AXIS, comm_size: int = None,
+                 **_ignored):
+        self.lr = float(lr)
+        self.b1, self.b2 = float(betas[0]), float(betas[1])
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.freeze_step = int(freeze_step)
+        self.axis = axis
+        self.n = int(comm_size if comm_size is not None
+                     else (mesh.shape.get(axis, 1) if mesh is not None else 1))
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        m = jax.tree_util.tree_map(zeros, params)
+        v = jax.tree_util.tree_map(zeros, params)
+        # Error buffers are PER-RANK state: stored [n, ...] with the leading
+        # dim sharded over data so each rank keeps its own slice across steps.
+        we = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(
+                (self.n, _pad_len(int(np.prod(p.shape) or 1), self.n)),
+                jnp.float32), params)
+        se = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(
+                (self.n, _pad_len(int(np.prod(p.shape) or 1), self.n)
+                 // self.n), jnp.float32), params)
+        return OneBitState(step=jnp.zeros((), jnp.int32), m=m, v=v,
+                           worker_error=we, server_error=se)
+
+    def state_specs(self, params):
+        """Placement: moments replicated, error buffers sharded over data
+        (consumed by the engine's local-grad shard_map path)."""
+        from jax.sharding import PartitionSpec as P
+
+        rep = jax.tree_util.tree_map(lambda _: P(), params)
+        shard0 = jax.tree_util.tree_map(lambda _: P(self.axis), params)
+        return OneBitState(step=P(), m=rep, v=rep,
+                           worker_error=shard0, server_error=shard0)
+
+    # ------------------------------------------------------------------
+    def update(self, grads, state: OneBitState, params, lr=None):
+        """grads are LOCAL (per-rank); runs inside data-manual shard_map."""
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        t = step.astype(jnp.float32)
+        warm = step <= self.freeze_step
+
+        def leaf(p, g, m, v, we, se):
+            g = g.astype(jnp.float32)
+            numel = int(np.prod(p.shape) or 1)
+            we2d, se2d = we.ndim == 2, se.ndim == 2
+            if we2d:
+                we = we[0]
+            if se2d:
+                se = se[0]
+            if self.n > 1:
+                g_dense = jax.lax.pmean(g, self.axis)
+            else:
+                g_dense = g
+            # --- warmup: plain Adam moment updates on the dense average ---
+            m_warm = self.b1 * m + (1 - self.b1) * g_dense
+            v_new = jnp.where(warm, self.b2 * v + (1 - self.b2) * g_dense**2, v)
+            # --- compressed phase: local momentum + 1-bit allreduce -------
+            if self.n > 1:
+                m_local = self.b1 * m + (1 - self.b1) * g
+                flat = jnp.zeros(we.shape[0], jnp.float32).at[:numel].set(
+                    m_local.reshape(-1))
+                synced, we_new, se_new = compressed_allreduce_local(
+                    flat, we, se, self.axis, self.n)
+                m_comp = synced[:numel].reshape(p.shape)
+            else:
+                m_comp, we_new, se_new = m_warm, we, se
+            m_new = jnp.where(warm, m_warm, m_comp)
+            we_new = jnp.where(warm, we, we_new)
+            se_new = jnp.where(warm, se, se_new)
+            if we2d:
+                we_new = we_new[None]
+            if se2d:
+                se_new = se_new[None]
+            # --- Adam step with bias correction ---------------------------
+            bc1 = 1 - self.b1 ** t
+            bc2 = 1 - self.b2 ** t
+            denom = jnp.sqrt(v_new / bc2) + self.eps
+            upd = (m_new / bc1) / denom
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p
+            return p - lr * upd, m_new, v_new, we_new, se_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.m)
+        flat_v = treedef.flatten_up_to(state.v)
+        flat_we = treedef.flatten_up_to(state.worker_error)
+        flat_se = treedef.flatten_up_to(state.server_error)
+        out = [leaf(*args) for args in
+               zip(flat_p, flat_g, flat_m, flat_v, flat_we, flat_se)]
+        unflat = lambda i: jax.tree_util.tree_unflatten(
+            treedef, [o[i] for o in out])
+        new_state = OneBitState(step=step, m=unflat(1), v=unflat(2),
+                                worker_error=unflat(3), server_error=unflat(4))
+        return unflat(0), new_state

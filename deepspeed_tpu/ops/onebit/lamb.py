@@ -1,0 +1,115 @@
+"""1-bit LAMB (reference ``deepspeed/runtime/fp16/onebit/lamb.py``): the
+compressed-momentum scheme of 1-bit Adam plus LAMB's layerwise trust-ratio
+scaling. During warmup it is plain LAMB; in the compressed phase the frozen
+variance and the scaling factors learned during warmup keep the layerwise
+adaptivity while only 1-bit momentum crosses the wire."""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.comm.compressed import compressed_allreduce_local
+from deepspeed_tpu.ops.onebit.adam import OneBitState, _pad_len
+from deepspeed_tpu.parallel.mesh import DATA_AXIS
+
+
+class OneBitLamb:
+    needs_local_grads = True
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-6,
+                 weight_decay: float = 0.0, freeze_step: int = 100,
+                 max_trust_ratio: float = 10.0, mesh=None,
+                 axis: str = DATA_AXIS, comm_size: int = None, **_ignored):
+        self.lr = float(lr)
+        self.b1, self.b2 = float(betas[0]), float(betas[1])
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.freeze_step = int(freeze_step)
+        self.max_trust = float(max_trust_ratio)
+        self.axis = axis
+        self.n = int(comm_size if comm_size is not None
+                     else (mesh.shape.get(axis, 1) if mesh is not None else 1))
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return OneBitState(
+            step=jnp.zeros((), jnp.int32),
+            m=jax.tree_util.tree_map(zeros, params),
+            v=jax.tree_util.tree_map(zeros, params),
+            worker_error=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(
+                    (self.n, _pad_len(int(np.prod(p.shape) or 1), self.n)),
+                    jnp.float32), params),
+            server_error=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(
+                    (self.n, _pad_len(int(np.prod(p.shape) or 1), self.n)
+                     // self.n), jnp.float32), params))
+
+    def state_specs(self, params):
+        from jax.sharding import PartitionSpec as P
+
+        rep = jax.tree_util.tree_map(lambda _: P(), params)
+        shard0 = jax.tree_util.tree_map(lambda _: P(self.axis), params)
+        return OneBitState(step=P(), m=rep, v=rep,
+                           worker_error=shard0, server_error=shard0)
+
+    def update(self, grads, state: OneBitState, params, lr=None):
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        t = step.astype(jnp.float32)
+        warm = step <= self.freeze_step
+
+        def leaf(p, g, m, v, we, se):
+            g = g.astype(jnp.float32)
+            numel = int(np.prod(p.shape) or 1)
+            we2d, se2d = we.ndim == 2, se.ndim == 2
+            if we2d:
+                we = we[0]
+            if se2d:
+                se = se[0]
+            g_dense = jax.lax.pmean(g, self.axis) if self.n > 1 else g
+            m_warm = self.b1 * m + (1 - self.b1) * g_dense
+            v_new = jnp.where(warm, self.b2 * v + (1 - self.b2) * g_dense**2, v)
+            if self.n > 1:
+                m_local = self.b1 * m + (1 - self.b1) * g
+                flat = jnp.zeros(we.shape[0], jnp.float32).at[:numel].set(
+                    m_local.reshape(-1))
+                synced, we_new, se_new = compressed_allreduce_local(
+                    flat, we, se, self.axis, self.n)
+                m_comp = synced[:numel].reshape(p.shape)
+            else:
+                m_comp, we_new, se_new = m_warm, we, se
+            m_new = jnp.where(warm, m_warm, m_comp)
+            we_new = jnp.where(warm, we, we_new)
+            se_new = jnp.where(warm, se, se_new)
+            if we2d:
+                we_new = we_new[None]
+            if se2d:
+                se_new = se_new[None]
+            bc1 = 1 - self.b1 ** t
+            bc2 = 1 - self.b2 ** t
+            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p
+            w_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(upd.reshape(-1))
+            trust = jnp.where((w_norm > 0) & (u_norm > 0),
+                              jnp.clip(w_norm / u_norm, 0.0, self.max_trust),
+                              1.0)
+            return p - lr * trust * upd, m_new, v_new, we_new, se_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        out = [leaf(*args) for args in zip(
+            flat_p,
+            treedef.flatten_up_to(grads),
+            treedef.flatten_up_to(state.m),
+            treedef.flatten_up_to(state.v),
+            treedef.flatten_up_to(state.worker_error),
+            treedef.flatten_up_to(state.server_error))]
+        unflat = lambda i: jax.tree_util.tree_unflatten(
+            treedef, [o[i] for o in out])
+        new_state = OneBitState(step=step, m=unflat(1), v=unflat(2),
+                                worker_error=unflat(3), server_error=unflat(4))
+        return unflat(0), new_state

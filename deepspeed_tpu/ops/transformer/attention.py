@@ -90,6 +90,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
               dropout_rng: Optional[jax.Array] = None,
               deterministic: bool = True,
               softmax_scale: Optional[float] = None,
+              mesh=None,
               impl: str = "auto") -> jax.Array:
     """Dispatching attention entry point used by every model family."""
     if impl == "auto":
@@ -112,4 +113,23 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                              dropout_rate=dropout_rate, dropout_rng=dropout_rng,
                              deterministic=deterministic,
                              softmax_scale=softmax_scale)
+    if impl in ("ring", "ulysses"):
+        if bias is not None or mask is not None or (
+                dropout_rate > 0.0 and not deterministic):
+            raise ValueError(f"impl='{impl}' does not take mask/bias/dropout")
+        if mesh is None:
+            from deepspeed_tpu.parallel.mesh import get_default_mesh
+
+            mesh = get_default_mesh()
+        if mesh is None:
+            raise ValueError(f"impl='{impl}' needs a mesh (pass mesh= or "
+                             "build the engine first, which registers one)")
+        from deepspeed_tpu.parallel.sequence import (ring_attention,
+                                                     ulysses_attention)
+
+        if impl == "ring":
+            return ring_attention(q, k, v, mesh=mesh, causal=causal,
+                                  softmax_scale=softmax_scale)
+        return ulysses_attention(q, k, v, mesh=mesh, causal=causal,
+                                 softmax_scale=softmax_scale)
     raise ValueError(f"unknown attention impl '{impl}'")

@@ -123,6 +123,21 @@ def single_device_mesh() -> Mesh:
     return build_mesh(data=1)
 
 
+# Ambient mesh: ops that need mesh-aware collectives (ring/Ulysses
+# attention selected by a model config string) read it when no mesh is
+# passed explicitly. The engine registers its mesh at construction.
+_DEFAULT_MESH: Optional[Mesh] = None
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    return _DEFAULT_MESH
+
+
 def data_sharding(mesh: Mesh, batch_axes: Sequence[str] = (DATA_AXIS,)) -> NamedSharding:
     """Sharding for input batches: leading dim split over data(-like) axes."""
     return NamedSharding(mesh, PartitionSpec(tuple(batch_axes)))

@@ -55,7 +55,6 @@ class PipelineEngine(TPUEngine):
                          params=pipe_model.params, config=config, mesh=mesh,
                          param_partition_specs=base_specs, **kwargs)
         self.num_stages = self.mesh.shape.get(PIPE_AXIS, 1)
-        pipe_model.check(self.num_stages)
         self.micro_batches = self.gradient_accumulation_steps
         log_dist(f"PipelineEngine: stages={self.num_stages} "
                  f"micro_batches={self.micro_batches}", ranks=[0])
@@ -87,8 +86,16 @@ class PipelineEngine(TPUEngine):
                 return pm.embed_fn(compute_params, b, k)
 
             embeds = jax.vmap(embed_one)(batches, jnp.arange(gas))
+            # aux presence is static (keyed on batch fields), so probe one
+            # microbatch before vmapping.
+            aux = None
+            if pm.aux_fn is not None:
+                first = jax.tree_util.tree_map(lambda x: x[0], batches)
+                if pm.aux_fn(compute_params, first) is not None:
+                    aux = jax.vmap(
+                        lambda b: pm.aux_fn(compute_params, b))(batches)
             h = pipeline_apply(pm.block_fn, compute_params["blocks"], embeds,
-                               mesh, rng=rng, num_microbatches=gas,
+                               mesh, aux=aux, rng=rng, num_microbatches=gas,
                                remat_blocks=True)
             losses = jax.vmap(
                 lambda hm, bm: pm.head_fn(compute_params, hm, bm))(h, batches)

@@ -14,8 +14,8 @@ partition_balanced, reference runtime/utils.py:342,:408 — in
 deepspeed_tpu.runtime.utils).
 """
 
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,9 +51,11 @@ class TiedLayerSpec(LayerSpec):
 class PipeModel:
     """Functional pipeline model: loss = head(embed(batch) |> blocks).
 
-    - embed_fn(params, batch, rng)            -> activations [mb, ...]
-    - block_fn(one_block_params, activations) -> activations
-    - head_fn(params, activations, batch)     -> scalar loss
+    - embed_fn(params, batch, rng)                  -> activations [mb, ...]
+    - block_fn(one_block_params, x, aux, rng)       -> activations
+    - head_fn(params, activations, batch)           -> scalar loss
+    - aux_fn(params, batch) -> per-microbatch side input for the blocks
+      (e.g. an attention mask) or None
     - params: {"embed": ..., "blocks": stacked [L, ...], "head": ...}
 
     embed_fn/head_fn receive the FULL params dict, so weight tying (e.g.
@@ -65,6 +67,7 @@ class PipeModel:
     head_fn: Callable
     params: Any
     num_blocks: int
+    aux_fn: Optional[Callable] = None
 
     def check(self, pipe_size: int) -> None:
         if self.num_blocks % pipe_size:
@@ -74,10 +77,14 @@ class PipeModel:
 
 def gpt_pipe_model(cfg, rng_key=None, example_batch=None) -> PipeModel:
     """Build a PipeModel from the in-tree GPT family (models/gpt.py):
-    embedding + dropout outside, L GPTBlocks pipelined, ln_f + tied LM head
-    + cross-entropy outside."""
+    embedding + dropout outside, L GPTBlocks pipelined (attention masks
+    travel as aux), ln_f + LM head (tied per cfg.tie_embeddings) +
+    cross-entropy outside."""
+    import flax.linen as nn
+
     from deepspeed_tpu.models.gpt import (GPT, GPTBlock,
-                                          cross_entropy_with_ignore)
+                                          cross_entropy_with_ignore,
+                                          shift_labels)
 
     if rng_key is None:
         rng_key = jax.random.PRNGKey(0)
@@ -95,15 +102,14 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None) -> PipeModel:
     from deepspeed_tpu.parallel.pipe.pipeline import stack_blocks
 
     blocks = stack_blocks([flat[f"h_{i}"] for i in range(cfg.num_layers)])
+    head = {"ln_f": flat["ln_f"]}
+    if not cfg.tie_embeddings:
+        head["lm_head"] = flat["lm_head"]
     params = {
         "embed": {"wte": flat["wte"], "wpe": flat["wpe"]},
         "blocks": blocks,
-        "head": {"ln_f": flat["ln_f"]},   # lm head tied to embed.wte
+        "head": head,
     }
-
-    import flax.linen as nn
-
-    from deepspeed_tpu.models.gpt import shift_labels
 
     def embed_fn(params, batch, rng):
         ids = batch["input_ids"]
@@ -116,24 +122,38 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None) -> PipeModel:
             x = jnp.where(keep, x / (1.0 - cfg.dropout_rate), 0.0)
         return x
 
-    def block_fn(p, x, rng):
+    def aux_fn(params, batch):
+        am = batch.get("attention_mask")
+        if am is None:
+            return None
+        # [mb, S] -> broadcastable [mb, 1, 1, S] attend-mask for GPTBlock.
+        return am[:, None, None, :].astype(jnp.bool_)
+
+    def block_fn(p, x, aux, rng):
         if rng is None or cfg.dropout_rate == 0.0:
-            return block.apply({"params": p}, x, None, True)
-        return block.apply({"params": p}, x, None, False,
+            return block.apply({"params": p}, x, aux, True)
+        return block.apply({"params": p}, x, aux, False,
                            rngs={"dropout": rng})
 
     # Final LN through flax's own LayerNorm (same impl/epsilon as the
-    # non-pipelined GPT's ln_f) + tied decode + shared label shift, so the
-    # two loss paths cannot drift.
+    # non-pipelined GPT's ln_f) + the model's decode convention (tied einsum
+    # or separate lm_head) + shared label shift, so the two loss paths
+    # cannot drift.
     ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32)
 
     def head_fn(params, x, batch):
         h = ln_f.apply({"params": params["head"]["ln_f"]}, x)
-        logits = jnp.einsum("bsd,vd->bsv", h.astype(cfg.dtype),
-                            params["embed"]["wte"].astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", h.astype(cfg.dtype),
+                                params["embed"]["wte"].astype(cfg.dtype),
+                                preferred_element_type=jnp.float32)
+        else:
+            kernel = params["head"]["lm_head"]["kernel"]
+            logits = jnp.einsum("bsd,dv->bsv", h.astype(cfg.dtype),
+                                kernel.astype(cfg.dtype),
+                                preferred_element_type=jnp.float32)
         return cross_entropy_with_ignore(logits, shift_labels(batch))
 
     return PipeModel(embed_fn=embed_fn, block_fn=block_fn,
-                     head_fn=head_fn, params=params,
+                     head_fn=head_fn, aux_fn=aux_fn, params=params,
                      num_blocks=cfg.num_layers)

@@ -21,7 +21,9 @@ plain GSPMD, which also ties input/output embeddings for free — the
 reference needs TiedLayerSpec + a dedicated allreduce group for this,
 module.py:73); the pipelined body is a stack of L structurally identical
 blocks, stacked on a leading dim that is sharded over ``pipe`` so each
-stage owns L/S consecutive blocks.
+stage owns L/S consecutive blocks. Per-microbatch side inputs (attention
+masks) travel as ``aux``, indexed by the schedule so stage s at tick t sees
+the aux of the microbatch it is actually processing (m = t − s).
 """
 
 import functools
@@ -32,7 +34,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from deepspeed_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
+from deepspeed_tpu.parallel.mesh import PIPE_AXIS
 
 
 def stack_blocks(block_params_list):
@@ -47,19 +49,29 @@ def pipeline_spec(blocks_params) -> Any:
         lambda x: P(PIPE_AXIS, *([None] * (x.ndim - 1))), blocks_params)
 
 
+# Cache of jitted pipelined programs: rebuilding shard_map+jit per call would
+# recompile on every eager invocation. Keyed by everything that changes the
+# traced program except array shapes (jit handles shape retracing itself).
+_PIPELINE_CACHE = {}
+
+
 def pipeline_apply(block_fn: Callable,
                    blocks_params: Any,
                    x: jax.Array,
                    mesh: Mesh,
                    *,
+                   aux: Any = None,
                    rng: Optional[jax.Array] = None,
                    num_microbatches: Optional[int] = None,
                    remat_blocks: bool = True) -> jax.Array:
     """Run the stacked-block pipeline over microbatches.
 
-    block_fn(params_one_block, x, rng_or_None) -> x  (one transformer block)
+    block_fn(params_one_block, x, aux_or_None, rng_or_None) -> x
     blocks_params: pytree, leaves [L, ...] — L % pipe_size == 0
     x: [M, mb, ...] microbatched activations (M = num_microbatches)
+    aux: optional pytree of per-microbatch side inputs, leaves [M, ...]
+         (e.g. attention masks) — handed to every block of the stage
+         processing that microbatch
     rng: PRNG key for per-block dropout (None ≡ deterministic)
 
     Returns [M, mb, ...] last-stage outputs. With pipe_size == 1 this
@@ -75,41 +87,53 @@ def pipeline_apply(block_fn: Callable,
     if x.shape[0] != M:
         raise ValueError(f"x has {x.shape[0]} microbatches, expected {M}")
 
-    fn = block_fn
-    if remat_blocks:
-        fn = jax.checkpoint(block_fn)
+    fn = jax.checkpoint(block_fn) if remat_blocks else block_fn
 
-    def stage_apply(stage_blocks, h, key):
+    def stage_apply(stage_blocks, h, a, key):
         # Apply this stage's L/S blocks in order (scan keeps the program
         # small; blocks are structurally identical by contract).
         def body(h, xs):
             p, i = xs
             k = None if key is None else jax.random.fold_in(key, i)
-            return fn(p, h, k), None
+            return fn(p, h, a, k), None
 
         n = jax.tree_util.tree_leaves(stage_blocks)[0].shape[0]
         h, _ = jax.lax.scan(body, h, (stage_blocks, jnp.arange(n)))
         return h
 
+    def aux_at(aux_all, idx):
+        if aux_all is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, idx, axis=0,
+                                                   keepdims=False), aux_all)
+
     if stages == 1:
         def per_mb(mb, i):
             key = None if rng is None else jax.random.fold_in(rng, i)
-            return stage_apply(blocks_params, mb, key)
+            a = aux_at(aux, i) if aux is not None else None
+            return stage_apply(blocks_params, mb, a, key)
 
-        return jax.vmap(per_mb)(x, jnp.arange(M))
+        if aux is None:
+            return jax.vmap(lambda mb, i: per_mb(mb, i))(x, jnp.arange(M))
+        # aux indexing is data-dependent per microbatch — use scan
+        def body(_, mi):
+            mb, i = mi
+            return None, per_mb(mb, i)
+
+        _, out = jax.lax.scan(body, None, (x, jnp.arange(M)))
+        return out
 
     T = M + stages - 1
-
     compute_dtype = x.dtype
 
-    def pipelined(stage_blocks, x_all, *key):
+    def pipelined(stage_blocks, x_all, aux_all, keys):
         # stage_blocks leaves: [L/S, ...] (pipe dim stripped; other axes
         # remain GSPMD-auto); x_all: [M, mb, ...] replicated across pipe.
         # x crosses the shard_map boundary in fp32 (see psum note below:
         # the cotangent of a pipe-replicated input is a psum, which must
         # not run in bf16 under a partial-manual shard_map).
         x_all = x_all.astype(compute_dtype)
-        keys = key[0] if key else None
         rank = jax.lax.axis_index(PIPE_AXIS)
         shift = [(i, (i + 1) % stages) for i in range(stages)]
 
@@ -118,9 +142,11 @@ def pipeline_apply(block_fn: Callable,
             inject = jax.lax.dynamic_index_in_dim(
                 x_all, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
             h = jnp.where(rank == 0, inject, buf)
+            # Stage `rank` processes microbatch t - rank at tick t.
+            a = aux_at(aux_all, jnp.clip(t - rank, 0, M - 1))
             k = (None if keys is None
                  else jax.random.fold_in(jax.random.fold_in(keys, t), rank))
-            y = stage_apply(stage_blocks, h, k)
+            y = stage_apply(stage_blocks, h, a, k)
             buf = jax.lax.ppermute(y, PIPE_AXIS, shift)
             return buf, y
 
@@ -138,15 +164,24 @@ def pipeline_apply(block_fn: Callable,
                            jnp.zeros_like(out)).astype(jnp.float32)
         return jax.lax.psum(masked, PIPE_AXIS).astype(out.dtype)
 
-    args = (blocks_params, x.astype(jnp.float32)) + \
-        (() if rng is None else (rng,))
-    in_specs = (pipeline_spec(blocks_params), P()) + \
-        (() if rng is None else (P(),))
-    return shard_map(
-        pipelined,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=P(),
-        axis_names={PIPE_AXIS},
-        check_vma=False,
-    )(*args)
+    blocks_treedef = jax.tree_util.tree_structure(blocks_params)
+    blocks_ndims = tuple(l.ndim for l in jax.tree_util.tree_leaves(blocks_params))
+    aux_treedef = (None if aux is None
+                   else jax.tree_util.tree_structure(aux))
+    key = (block_fn, mesh, stages, M, remat_blocks, rng is None,
+           blocks_treedef, blocks_ndims, aux_treedef, compute_dtype)
+    if key not in _PIPELINE_CACHE:
+        def entry(blocks_arg, x_arg, aux_arg, rng_arg):
+            return shard_map(
+                pipelined,
+                mesh=mesh,
+                in_specs=(pipeline_spec(blocks_arg), P(), P(), P()),
+                out_specs=P(),
+                axis_names={PIPE_AXIS},
+                check_vma=False,
+            )(blocks_arg, x_arg, aux_arg, rng_arg)
+
+        # Partial-manual shard_map only traces under jit; the jit also makes
+        # repeated eager calls hit the compile cache.
+        _PIPELINE_CACHE[key] = jax.jit(entry)
+    return _PIPELINE_CACHE[key](blocks_params, x.astype(jnp.float32), aux, rng)

@@ -216,14 +216,6 @@ class DataParallelSchedule(PipeSchedule):
         return 1
 
 
-def _is_even(x: int) -> bool:
-    return x % 2 == 0
-
-
-def _is_odd(x: int) -> bool:
-    return x % 2 != 0
-
-
 def bubble_fraction(micro_batches: int, stages: int) -> float:
     """Pipeline bubble overhead (S-1)/(M+S-1) — utilisation analysis."""
     return (stages - 1) / (micro_batches + stages - 1)

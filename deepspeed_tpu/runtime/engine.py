@@ -39,7 +39,9 @@ from deepspeed_tpu.config.config import DeepSpeedTPUConfig
 from deepspeed_tpu.config import constants as C
 from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, FusedAdamW, HostOffloadAdam
 from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
-from deepspeed_tpu.parallel.mesh import DATA_AXIS, build_mesh
+from deepspeed_tpu.parallel.mesh import (DATA_AXIS, build_mesh,
+                                         set_default_mesh as
+                                         mesh_lib_set_default)
 from deepspeed_tpu.runtime.lr_schedules import build_lr_schedule
 from deepspeed_tpu.runtime.precision import (LossScaleState, PrecisionPolicy,
                                              make_loss_scaler)
@@ -136,6 +138,13 @@ class TPUEngine:
             data=-1, model=config.mesh.model, pipe=config.mesh.pipe,
             sequence=config.mesh.sequence, expert=config.mesh.expert)
         self.dp_size = self.mesh.shape.get(DATA_AXIS, 1)
+        # Register as the ambient mesh for mesh-needing ops (ring/ulysses
+        # attention) — but never steal it from an earlier engine: with two
+        # live engines the later construction would silently repoint the
+        # first engine's attention to the wrong mesh.
+        from deepspeed_tpu.parallel.mesh import get_default_mesh
+        if get_default_mesh() is None:
+            mesh_lib_set_default(self.mesh)
 
         # --- precision ------------------------------------------------------
         self.precision = PrecisionPolicy(config.precision_dtype)
@@ -265,7 +274,10 @@ class TPUEngine:
     def _opt_state_specs(self, opt_state: Any, params: Any) -> Any:
         """Spec tree for the optimizer state: any sub-tree that mirrors the
         param tree structure (moment trees) gets the ZeRO opt-state specs;
-        everything else (step counters etc.) is replicated."""
+        everything else (step counters etc.) is replicated. Optimizers with
+        bespoke layouts (1-bit error buffers) provide ``state_specs``."""
+        if hasattr(self.optimizer, "state_specs"):
+            return self.optimizer.state_specs(params)
         params_structure = jax.tree_util.tree_structure(params)
 
         def specs_for(sub):
@@ -319,6 +331,9 @@ class TPUEngine:
         return apply_step
 
     def _build_step_fns(self) -> None:
+        if getattr(self.optimizer, "needs_local_grads", False):
+            self._build_local_grad_step_fns()
+            return
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         fp16 = cfg.fp16.enabled
@@ -376,6 +391,109 @@ class TPUEngine:
         self._train_step = jax.jit(train_step, donate_argnums=donate)
         self._eval_step = jax.jit(eval_step)
 
+    def _build_local_grad_step_fns(self) -> None:
+        """Step functions for communication-efficient optimizers
+        (OneBitAdam/OneBitLamb, reference runtime/fp16/onebit/): the whole
+        fused step runs inside a shard_map manual over ``data`` so the
+        optimizer sees LOCAL (unreduced) gradients and performs its own
+        compressed collective — the engine's dense grad allreduce is
+        bypassed, exactly like the reference disables its own allreduce for
+        1-bit optimizers (onebit/adam.py:98). Restrictions: ZeRO stage 0,
+        ``train_batch()`` only (no per-microbatch forward/backward), no
+        engine-side gradient clipping."""
+        from deepspeed_tpu.parallel.mesh import DATA_AXIS
+
+        cfg = self.config
+        if cfg.zero_config.stage != 0:
+            raise ValueError("1-bit optimizers require ZeRO stage 0 "
+                             "(compressed comm replaces the grad allreduce)")
+        gas = cfg.gradient_accumulation_steps
+        fp16 = cfg.fp16.enabled
+        precision = self.precision
+        loss_fn = self.loss_fn
+        mesh = self.mesh
+        optimizer = self.optimizer
+        scaler = self.loss_scaler
+        axis = DATA_AXIS
+        n = self.dp_size
+
+        from jax import shard_map
+
+        state_specs = jax.tree_util.tree_map(
+            lambda _: PartitionSpec(), self.state)
+        if hasattr(optimizer, "state_specs"):
+            state_specs = state_specs._replace(
+                opt_state=optimizer.state_specs(self.state.params))
+
+        def train_step_local(state: TrainState, batches, lr):
+            def body(st, batch):
+                rng, sub = jax.random.split(st.rng)
+                sub = jax.random.fold_in(sub, jax.lax.axis_index(axis))
+                compute_params = precision.cast_params(st.params)
+                scale = st.loss_scale.scale if fp16 else jnp.float32(1.0)
+
+                def scaled(cp):
+                    out = loss_fn(cp, batch, sub)
+                    loss = (out[0] if isinstance(out, tuple) else out)
+                    loss32 = loss.astype(jnp.float32)
+                    return loss32 * scale / gas, loss32
+
+                (_, loss), grads = jax.value_and_grad(
+                    scaled, has_aux=True)(compute_params)
+                grads = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), st.grad_acc, grads)
+                return st._replace(micro_step=st.micro_step + 1,
+                                   grad_acc=grads, rng=rng), loss
+
+            state, losses = jax.lax.scan(body, state, batches)
+            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
+            grads = jax.tree_util.tree_map(
+                lambda g: g / scale, state.grad_acc)
+            if fp16:
+                local_of = has_inf_or_nan(grads).astype(jnp.int32)
+                overflow = jax.lax.pmax(local_of, axis) > 0
+            else:
+                overflow = jnp.zeros((), jnp.bool_)
+            new_params, new_opt = optimizer.update(grads, state.opt_state,
+                                                   state.params, lr=lr)
+            new_params = _tree_where(overflow, state.params, new_params)
+            new_opt = _tree_where(overflow, state.opt_state, new_opt)
+            new_ls = scaler.update(state.loss_scale, overflow)
+            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
+            state = state._replace(
+                step=state.step + jnp.where(overflow, 0, 1),
+                params=new_params, opt_state=new_opt, grad_acc=zero_acc,
+                loss_scale=new_ls,
+                skipped_steps=state.skipped_steps + overflow.astype(jnp.int32))
+            loss_mean = jax.lax.pmean(jnp.mean(losses), axis)
+            return state, loss_mean, overflow, jnp.float32(0.0)
+
+        # Batch spec: honor the engine's batch_spec, keeping only the data
+        # axis manual (other axes stay GSPMD-auto and may not appear in a
+        # data-manual shard_map's specs).
+        data_only = tuple(
+            a if a == DATA_AXIS else None for a in tuple(self.batch_spec))
+        batch_in_spec = PartitionSpec(None, *data_only)
+        mapped = shard_map(
+            train_step_local, mesh=mesh,
+            in_specs=(state_specs, batch_in_spec, PartitionSpec()),
+            out_specs=(state_specs, PartitionSpec(), PartitionSpec(),
+                       PartitionSpec()),
+            axis_names={axis},
+            check_vma=False)
+        donate = (0,) if self._donate else ()
+        self._train_step = jax.jit(mapped, donate_argnums=donate)
+
+        def eval_step(state: TrainState, batch):
+            compute_params = precision.cast_params(state.params)
+            out = loss_fn(compute_params, batch, None)
+            loss, aux = (out if isinstance(out, tuple) else (out, None))
+            return loss.astype(jnp.float32), aux
+
+        self._eval_step = jax.jit(eval_step)
+        self._micro_step = None
+        self._apply_step = None
+
     # ------------------------------------------------------------------
     # Public API (reference parity: engine(batch) / backward / step)
     # ------------------------------------------------------------------
@@ -408,6 +526,10 @@ class TPUEngine:
 
     def forward(self, batch):
         """Compute loss and accumulate grads for one micro-batch."""
+        if self._micro_step is None:
+            raise RuntimeError(
+                "this optimizer requires the fused train_batch() path "
+                "(1-bit optimizers accumulate local grads inside one step)")
         if self.wall_clock_breakdown:
             self.timers("forward").start()
         batch = self.put_batch(batch)
