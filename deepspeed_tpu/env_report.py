@@ -1,0 +1,79 @@
+"""Environment / capability report — the ``ds_report`` analogue
+(reference ``deepspeed/env_report.py``): instead of probing CUDA op
+builders, reports the JAX/TPU stack and which framework features are
+usable in this environment."""
+
+import importlib
+import sys
+
+
+GREEN_OK = "\033[92m[OK]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _try_import(name):
+    try:
+        mod = importlib.import_module(name)
+        return getattr(mod, "__version__", "unknown")
+    except Exception:
+        return None
+
+
+def collect_report() -> dict:
+    import deepspeed_tpu
+
+    report = {
+        "deepspeed_tpu": deepspeed_tpu.__version__,
+        "python": sys.version.split()[0],
+        "packages": {},
+        "devices": [],
+        "platform": None,
+        "features": {},
+    }
+    for pkg in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
+        report["packages"][pkg] = _try_import(pkg)
+
+    try:
+        import jax
+
+        report["platform"] = jax.devices()[0].platform
+        report["devices"] = [str(d) for d in jax.devices()]
+        report["process_count"] = jax.process_count()
+    except Exception as e:  # no backend
+        report["platform"] = f"unavailable ({e})"
+
+    on_tpu = report["platform"] == "tpu"
+    report["features"] = {
+        "pallas_kernels (flash/sparse attention)": on_tpu,
+        "xla_reference_ops": report["packages"]["jax"] is not None,
+        "multihost (jax.distributed)": report["packages"]["jax"] is not None,
+        "zero_stages_0_3": True,
+        "pipeline_parallelism": True,
+        "sequence_parallelism (ring/ulysses)": True,
+        "onebit_optimizers": True,
+    }
+    return report
+
+
+def main():
+    report = collect_report()
+    print("-" * 60)
+    print("DeepSpeed-TPU environment report")
+    print("-" * 60)
+    print(f"deepspeed_tpu .......... {report['deepspeed_tpu']}")
+    print(f"python ................. {report['python']}")
+    for pkg, ver in report["packages"].items():
+        mark = GREEN_OK if ver else RED_NO
+        print(f"{pkg:22s} {mark} {ver or 'not installed'}")
+    print(f"platform ............... {report['platform']}")
+    for d in report["devices"]:
+        print(f"  device: {d}")
+    print("-" * 60)
+    print("feature availability")
+    for feat, ok in report["features"].items():
+        print(f"  {GREEN_OK if ok else RED_NO} {feat}")
+    print("-" * 60)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Per-host launcher (reference ``deepspeed/launcher/launch.py:67``).
+
+The reference spawns one subprocess per local GPU with RANK/LOCAL_RANK env
+and babysits them (kill-all on first failure, :151-167). A TPU host runs
+ONE worker process that owns all local chips; this launcher therefore
+decodes the world info, exports the jax.distributed rendezvous variables
+(DSTPU_COORDINATOR / DSTPU_NUM_PROCS / DSTPU_RANK, consumed by
+``parallel.mesh.init_distributed``) and execs the user script, babysitting
+it for signal-forwarding parity.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.runner import decode_world_info
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(description="per-host launcher")
+    parser.add_argument("--world_info", type=str, required=True)
+    parser.add_argument("--node_rank", type=int, required=True)
+    parser.add_argument("--master_addr", type=str, required=True)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def build_env(world_info: str, node_rank: int, master_addr: str,
+              master_port: int) -> dict:
+    world = decode_world_info(world_info)
+    hosts = list(world.keys())
+    if not 0 <= node_rank < len(hosts):
+        raise ValueError(f"node_rank {node_rank} out of range for "
+                         f"{len(hosts)} hosts")
+    env = dict(os.environ)
+    env.update({
+        "DSTPU_COORDINATOR": f"{master_addr}:{master_port}",
+        "DSTPU_NUM_PROCS": str(len(hosts)),
+        "DSTPU_RANK": str(node_rank),
+        # reference-compatible aliases
+        "MASTER_ADDR": master_addr,
+        "MASTER_PORT": str(master_port),
+        "RANK": str(node_rank),
+        "WORLD_SIZE": str(len(hosts)),
+        "LOCAL_RANK": "0",
+    })
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    env = build_env(args.world_info, args.node_rank, args.master_addr,
+                    args.master_port)
+    cmd = [sys.executable, args.user_script] + list(args.user_args)
+    logger.info("node %s exec: %s", args.node_rank, " ".join(cmd))
+    proc = subprocess.Popen(cmd, env=env)
+
+    def forward(signum, _frame):
+        proc.send_signal(signum)
+
+    signal.signal(signal.SIGINT, forward)
+    signal.signal(signal.SIGTERM, forward)
+    rc = proc.wait()
+    if rc != 0:
+        logger.error("worker exited with code %s — terminating", rc)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,213 @@
+"""Multi-node launch front-end — the ``deepspeed`` CLI (reference
+``deepspeed/launcher/runner.py:33-372``).
+
+Differences from the reference, driven by TPU topology: NCCL wants one
+process per GPU; a TPU host drives ALL its local chips from one process via
+``jax.distributed.initialize``, so the runner launches ONE worker per host
+(slots in the hostfile = chips, used for bookkeeping/filters, not process
+counts). The rendezvous coordinator is the first included host.
+
+Hostfile syntax is the reference's: ``hostname slots=N`` lines, ``#``
+comments. Inclusion/exclusion filters use the reference's
+``node1@node2:0,2`` syntax (reference runner.py:151 parse_resource_filter).
+"""
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_MASTER_PORT = 29500
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed-tpu multi-host launcher")
+    parser.add_argument("-H", "--hostfile", type=str, default="/job/hostfile",
+                        help="Hostfile: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="Node/slot inclusion filter, e.g. "
+                             "'node1@node2:0,2'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Node/slot exclusion filter")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="Limit to first N nodes of the hostfile")
+    parser.add_argument("--master_port", type=int,
+                        default=DEFAULT_MASTER_PORT)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "local"],
+                        help="Multi-node backend")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="Treat as multi-node even for one host")
+    parser.add_argument("user_script", type=str,
+                        help="User training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(path: str) -> "OrderedDict[str, int]":
+    """Parse '<host> slots=<n>' lines (reference runner.py:120)."""
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    if not os.path.isfile(path):
+        return resources
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                raise ValueError(f"hostfile line malformed: '{line}' "
+                                 "(expected '<host> slots=<n>')")
+            if hostname in resources:
+                raise ValueError(f"hostfile duplicates host '{hostname}'")
+            resources[hostname] = slot_count
+    return resources
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """'node1@node2:0,2' -> {node1: None, node2: [0, 2]}."""
+    out: Dict[str, Optional[List[int]]] = {}
+    if not spec:
+        return out
+    for part in spec.split("@"):
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host] = sorted(int(s) for s in slots.split(","))
+        else:
+            out[part] = None
+    return out
+
+
+def parse_inclusion_exclusion(resources: "OrderedDict[str, int]",
+                              include: str,
+                              exclude: str) -> "OrderedDict[str, List[int]]":
+    """Apply include/exclude filters to {host: slot_count}
+    (reference runner.py:151,:243). Returns {host: [slot ids]}."""
+    active: "OrderedDict[str, List[int]]" = OrderedDict(
+        (h, list(range(n))) for h, n in resources.items())
+    inc = _parse_filter(include)
+    exc = _parse_filter(exclude)
+    if inc and exc:
+        raise ValueError("specify only one of include/exclude filters")
+    if inc:
+        filtered: "OrderedDict[str, List[int]]" = OrderedDict()
+        for host, slots in inc.items():
+            if host not in active:
+                raise ValueError(f"included host '{host}' not in hostfile")
+            sel = slots if slots is not None else active[host]
+            bad = set(sel) - set(active[host])
+            if bad:
+                raise ValueError(f"included slots {sorted(bad)} not on {host}")
+            filtered[host] = sel
+        return filtered
+    for host, slots in exc.items():
+        if host not in active:
+            raise ValueError(f"excluded host '{host}' not in hostfile")
+        if slots is None:
+            del active[host]
+        else:
+            active[host] = [s for s in active[host] if s not in slots]
+            if not active[host]:
+                del active[host]
+    return active
+
+
+def encode_world_info(active: "OrderedDict[str, List[int]]") -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(active).encode()).decode()
+
+
+def decode_world_info(blob: str) -> Dict[str, List[int]]:
+    return json.loads(base64.urlsafe_b64decode(blob.encode()).decode())
+
+
+def build_host_command(host_idx: int, world: "OrderedDict[str, List[int]]",
+                       args, env_exports: Dict[str, str]) -> List[str]:
+    """The per-host command: python -m deepspeed_tpu.launcher.launch ..."""
+    world_blob = encode_world_info(world)
+    hosts = list(world.keys())
+    master = args.master_addr or hosts[0]
+    cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+           f"--world_info={world_blob}",
+           f"--node_rank={host_idx}",
+           f"--master_addr={master}",
+           f"--master_port={args.master_port}",
+           args.user_script] + list(args.user_args)
+    return cmd
+
+
+def propagated_env() -> Dict[str, str]:
+    """Environment forwarded to workers (reference forwards NCCL*/PYTHON*
+    /etc; here: JAX/XLA/TPU/PYTHON plus .deepspeed_env extras,
+    reference runner.py:330-346)."""
+    prefixes = ("JAX", "XLA", "TPU", "LIBTPU", "PYTHON", "DSTPU")
+    env = {k: v for k, v in os.environ.items()
+           if any(k.startswith(p) for p in prefixes)}
+    dot_env = os.path.join(os.path.expanduser("~"), ".deepspeed_env")
+    if os.path.isfile(dot_env):
+        with open(dot_env) as f:
+            for line in f:
+                line = line.strip()
+                if line and "=" in line and not line.startswith("#"):
+                    k, v = line.split("=", 1)
+                    env[k] = v
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        # single-node fallback: localhost with all local chips
+        resources = OrderedDict([("localhost", -1)])
+    if args.num_nodes > 0:
+        resources = OrderedDict(list(resources.items())[:args.num_nodes])
+    active = parse_inclusion_exclusion(
+        OrderedDict((h, (n if n > 0 else 8)) for h, n in resources.items()),
+        args.include, args.exclude)
+    if not active:
+        raise RuntimeError("no hosts left after filters")
+    hosts = list(active.keys())
+    env = propagated_env()
+
+    multi_node = args.force_multi or len(hosts) > 1
+    if not multi_node:
+        cmd = build_host_command(0, active, args, env)
+        logger.info("single-node launch: %s", " ".join(map(shlex.quote, cmd)))
+        result = subprocess.run(cmd, env={**os.environ, **env})
+        sys.exit(result.returncode)
+
+    # multi-node: one remote command per host over ssh/pdsh
+    procs = []
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    for idx, host in enumerate(hosts):
+        cmd = build_host_command(idx, active, args, env)
+        remote = f"cd {shlex.quote(os.getcwd())} && {exports} " + \
+            " ".join(map(shlex.quote, cmd))
+        if args.launcher == "pdsh":
+            full = ["pdsh", "-w", host, remote]
+        else:
+            full = ["ssh", host, remote]
+        logger.info("launching on %s: %s", host, remote)
+        procs.append(subprocess.Popen(full))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

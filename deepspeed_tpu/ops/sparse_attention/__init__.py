@@ -1,0 +1,17 @@
+"""Block-sparse attention: sparsity layouts + executors."""
+
+from deepspeed_tpu.ops.sparse_attention.sparse_attention import (
+    SparseSelfAttention, layout_kv_indices, layout_to_dense_mask,
+    pad_to_block_size, sparse_attention)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, SparsityConfig, VariableSparsityConfig,
+    causal_blockmask)
+
+__all__ = [
+    "SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+    "VariableSparsityConfig", "BigBirdSparsityConfig",
+    "BSLongformerSparsityConfig", "causal_blockmask", "sparse_attention",
+    "SparseSelfAttention", "layout_to_dense_mask", "layout_kv_indices",
+    "pad_to_block_size",
+]

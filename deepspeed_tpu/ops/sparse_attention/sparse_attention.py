@@ -1,0 +1,202 @@
+"""Block-sparse attention executors.
+
+The reference executes block-sparse attention with Triton SDD/DSD/DDS
+matmuls + block softmax (``ops/sparse_attention/matmul.py``,
+``softmax.py``); here the same layouts run through:
+
+- ``impl="xla"`` — dense attention under the layout-expanded mask (the
+  numerics oracle, and perfectly fine for modest sequence lengths);
+- ``impl="pallas"`` — a flash-style Pallas kernel that, per (head,
+  q-block), loops ONLY over that row's active kv-blocks. The active-index
+  list is precomputed on the host from the (static) layout, so compute and
+  HBM traffic scale with layout density — the O(s·√s) long-sequence story
+  of the reference (docs/index.md:142), TPU-style.
+
+Layouts come from ``sparsity_config.py`` as [H, B, B] int32.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def layout_to_dense_mask(layout: np.ndarray, block: int) -> np.ndarray:
+    """[H, B, B] block layout -> [H, S, S] bool element mask."""
+    return np.kron(np.asarray(layout), np.ones((block, block))).astype(bool)
+
+
+def layout_kv_indices(layout: np.ndarray):
+    """Per (head, q-block) active kv-block ids, padded with -1:
+    -> int32 [H, B, max_active]."""
+    layout = np.asarray(layout)
+    h, b, _ = layout.shape
+    max_active = int(layout.sum(-1).max())
+    idx = np.full((h, b, max_active), -1, np.int32)
+    for hi in range(h):
+        for qi in range(b):
+            cols = np.nonzero(layout[hi, qi])[0]
+            idx[hi, qi, :len(cols)] = cols
+    return idx, max_active
+
+
+def _xla_sparse(q, k, v, layout, block, causal, scale):
+    mask = jnp.asarray(layout_to_dense_mask(layout, block))   # [H, S, S]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(mask[None], logits, NEG_INF)
+    if causal:
+        s = q.shape[1]
+        cm = jnp.tril(jnp.ones((s, s), jnp.bool_))
+        logits = jnp.where(cm[None, None], logits, NEG_INF)
+    # guard fully-masked rows (no allowed keys) against NaN
+    rowmax = jnp.max(logits, axis=-1, keepdims=True)
+    probs = jnp.where(rowmax > NEG_INF / 2,
+                      jax.nn.softmax(logits, axis=-1), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+
+
+def _sparse_kernel(kv_idx_ref, q_ref, k_ref, v_ref, o_ref, *,
+                   causal: bool, scale: float, block: int, num_heads: int,
+                   max_active: int):
+    """grid: (B*H, q_blocks). Refs: q [1, block, D]; k/v [1, S, D];
+    kv_idx [H, qb, max_active] in SMEM (scalar-prefetched — SMEM supports
+    the arbitrary dynamic indexing a layout lookup needs)."""
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    h = jax.lax.rem(bh, num_heads)
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        ki = kv_idx_ref[h, qi, j]
+        active = ki >= 0
+        ki_safe = jnp.maximum(ki, 0)
+        kblk = k_ref[0, pl.ds(ki_safe * block, block), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(ki_safe * block, block), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_pos = ki_safe * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(active, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # rows that have seen nothing yet keep NEG_INF; exp underflows to 0
+        p = jnp.exp(s - jnp.maximum(m_new, NEG_INF / 2)[:, None])
+        alpha = jnp.exp(m_prev - jnp.maximum(m_new, NEG_INF / 2))
+        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, alpha)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, vblk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    init = (jnp.full((block,), NEG_INF, jnp.float32),
+            jnp.zeros((block,), jnp.float32),
+            jnp.zeros((block, d), jnp.float32))
+    m, l, acc = jax.lax.fori_loop(0, max_active, body, init)
+    out = jnp.where((l > 0)[:, None], acc / jnp.maximum(l, 1e-30)[:, None], 0.0)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _pallas_sparse(q, k, v, layout, block, causal, scale, interpret):
+    b, s, h, d = q.shape
+    kv_idx, max_active = layout_kv_indices(np.asarray(layout))
+    qb = s // block
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+
+    kernel = functools.partial(_sparse_kernel, causal=causal, scale=scale,
+                               block=block, num_heads=h,
+                               max_active=max_active)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,       # kv_idx rides in SMEM
+        grid=(b * h, qb),
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda bh, i, idx: (bh, i, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, i, idx: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, i, idx: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, d), lambda bh, i, idx: (bh, i, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(kv_idx), qf, kf, vf)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     layout, block: int, *,
+                     causal: bool = False,
+                     softmax_scale: Optional[float] = None,
+                     impl: str = "xla",
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """Block-sparse attention over [B, S, H, D] with an [H, B, B] layout."""
+    s = q.shape[1]
+    if s % block:
+        raise ValueError(f"seq {s} not divisible by block {block}")
+    if np.asarray(layout).shape[1] != s // block:
+        raise ValueError(f"layout has {np.asarray(layout).shape[1]} blocks, "
+                         f"sequence needs {s // block}")
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    if impl == "xla":
+        return _xla_sparse(q, k, v, layout, block, causal, scale)
+    if impl == "pallas":
+        if interpret is None:
+            interpret = jax.devices()[0].platform != "tpu"
+        return _pallas_sparse(q, k, v, layout, block, causal, scale, interpret)
+    raise ValueError(f"unknown sparse attention impl '{impl}'")
+
+
+class SparseSelfAttention:
+    """Layout-bound attention callable (reference
+    ops/sparse_attention/sparse_self_attention.py:14): construct once with a
+    SparsityConfig, call with q/k/v [B, S, H, D]."""
+
+    def __init__(self, sparsity_config, max_seq_length: int = 2048,
+                 attn_mask_mode: str = "mul", impl: str = "xla"):
+        self.sparsity_config = sparsity_config
+        self.max_seq_length = max_seq_length
+        self.impl = impl
+        self._layouts = {}
+
+    def layout(self, seq_len: int):
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, q, k, v, *, causal: Optional[bool] = None):
+        if causal is None:
+            causal = getattr(self.sparsity_config, "attention",
+                             "bidirectional") == "unidirectional"
+        return sparse_attention(q, k, v, self.layout(q.shape[1]),
+                                self.sparsity_config.block, causal=causal,
+                                impl=self.impl)
+
+
+def pad_to_block_size(x: jax.Array, block: int, axis: int = 1):
+    """SparseAttentionUtils.pad_to_block_size analogue: right-pad the seq
+    axis to a block multiple; returns (padded, pad_len)."""
+    s = x.shape[axis]
+    pad = (-s) % block
+    if pad == 0:
+        return x, 0
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths), pad

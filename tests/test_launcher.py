@@ -1,0 +1,114 @@
+"""Launcher unit tests (reference tests/unit/test_dist.py-adjacent +
+runner parsing behaviors): hostfile parsing, include/exclude filters,
+world-info encoding, per-host env construction, env report."""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.env_report import collect_report
+from deepspeed_tpu.launcher import launch, runner
+
+
+def _write_hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+class TestHostfile:
+    def test_parse(self, tmp_path):
+        hf = _write_hostfile(tmp_path, """
+# comment
+worker-0 slots=4
+worker-1 slots=8   # trailing comment
+""")
+        res = runner.fetch_hostfile(hf)
+        assert res == {"worker-0": 4, "worker-1": 8}
+        assert list(res) == ["worker-0", "worker-1"]  # order preserved
+
+    def test_missing_returns_empty(self):
+        assert runner.fetch_hostfile("/nonexistent") == {}
+
+    def test_malformed_raises(self, tmp_path):
+        hf = _write_hostfile(tmp_path, "worker-0 gpus=4\n")
+        with pytest.raises(ValueError, match="malformed"):
+            runner.fetch_hostfile(hf)
+
+    def test_duplicate_raises(self, tmp_path):
+        hf = _write_hostfile(tmp_path, "w0 slots=2\nw0 slots=2\n")
+        with pytest.raises(ValueError, match="duplicates"):
+            runner.fetch_hostfile(hf)
+
+
+class TestFilters:
+    def _resources(self):
+        from collections import OrderedDict
+
+        return OrderedDict([("w0", 4), ("w1", 4), ("w2", 4)])
+
+    def test_no_filters(self):
+        active = runner.parse_inclusion_exclusion(self._resources(), "", "")
+        assert active == {"w0": [0, 1, 2, 3], "w1": [0, 1, 2, 3],
+                          "w2": [0, 1, 2, 3]}
+
+    def test_include_hosts_and_slots(self):
+        active = runner.parse_inclusion_exclusion(
+            self._resources(), "w0@w2:0,2", "")
+        assert active == {"w0": [0, 1, 2, 3], "w2": [0, 2]}
+
+    def test_exclude_host(self):
+        active = runner.parse_inclusion_exclusion(self._resources(), "", "w1")
+        assert list(active) == ["w0", "w2"]
+
+    def test_exclude_slots(self):
+        active = runner.parse_inclusion_exclusion(
+            self._resources(), "", "w0:1,3")
+        assert active["w0"] == [0, 2]
+
+    def test_both_filters_raise(self):
+        with pytest.raises(ValueError, match="only one"):
+            runner.parse_inclusion_exclusion(self._resources(), "w0", "w1")
+
+    def test_unknown_host_raises(self):
+        with pytest.raises(ValueError, match="not in hostfile"):
+            runner.parse_inclusion_exclusion(self._resources(), "wX", "")
+
+
+class TestWorldInfo:
+    def test_roundtrip(self):
+        from collections import OrderedDict
+
+        world = OrderedDict([("w0", [0, 1]), ("w1", [0, 1, 2])])
+        blob = runner.encode_world_info(world)
+        assert runner.decode_world_info(blob) == {"w0": [0, 1],
+                                                  "w1": [0, 1, 2]}
+
+
+class TestLaunchEnv:
+    def test_build_env(self):
+        from collections import OrderedDict
+
+        blob = runner.encode_world_info(
+            OrderedDict([("hostA", [0]), ("hostB", [0])]))
+        env = launch.build_env(blob, 1, "hostA", 29501)
+        assert env["DSTPU_COORDINATOR"] == "hostA:29501"
+        assert env["DSTPU_NUM_PROCS"] == "2"
+        assert env["DSTPU_RANK"] == "1"
+        assert env["MASTER_ADDR"] == "hostA"
+        assert env["WORLD_SIZE"] == "2"
+
+    def test_bad_node_rank(self):
+        from collections import OrderedDict
+
+        blob = runner.encode_world_info(OrderedDict([("hostA", [0])]))
+        with pytest.raises(ValueError, match="out of range"):
+            launch.build_env(blob, 3, "hostA", 29500)
+
+
+class TestEnvReport:
+    def test_collect(self):
+        report = collect_report()
+        assert report["packages"]["jax"] is not None
+        assert report["platform"] in ("cpu", "tpu")
+        assert report["features"]["zero_stages_0_3"]

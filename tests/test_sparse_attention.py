@@ -1,0 +1,153 @@
+"""Sparse attention tests (reference tests/unit/test_sparse_attention.py):
+layout properties per config family, and Pallas block-sparse kernel parity
+against the dense-masked reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, SparseSelfAttention, VariableSparsityConfig,
+    layout_kv_indices, layout_to_dense_mask, pad_to_block_size,
+    sparse_attention)
+from deepspeed_tpu.ops.transformer.attention import xla_attention
+
+
+SEQ, BLOCK, HEADS = 256, 16, 4
+
+
+def _configs():
+    return [
+        DenseSparsityConfig(HEADS, BLOCK),
+        FixedSparsityConfig(HEADS, BLOCK, num_local_blocks=4,
+                            num_global_blocks=1),
+        FixedSparsityConfig(HEADS, BLOCK, num_local_blocks=4,
+                            num_global_blocks=1, attention="unidirectional"),
+        VariableSparsityConfig(HEADS, BLOCK, num_random_blocks=1,
+                               local_window_blocks=[2, 4],
+                               global_block_indices=[0, 7]),
+        BigBirdSparsityConfig(HEADS, BLOCK, num_random_blocks=2,
+                              num_sliding_window_blocks=3,
+                              num_global_blocks=1),
+        BSLongformerSparsityConfig(HEADS, BLOCK,
+                                   num_sliding_window_blocks=3,
+                                   global_block_indices=[0]),
+    ]
+
+
+class TestLayouts:
+    @pytest.mark.parametrize("cfg", _configs(),
+                             ids=lambda c: type(c).__name__)
+    def test_shape_and_diagonal(self, cfg):
+        layout = cfg.make_layout(SEQ)
+        b = SEQ // BLOCK
+        assert layout.shape == (HEADS, b, b)
+        assert layout.min() >= 0 and layout.max() <= 1
+        # every q block attends at least its own block's window: row nonzero
+        assert (layout.sum(-1) > 0).all()
+        # layouts are sparse (except Dense)
+        if not isinstance(cfg, DenseSparsityConfig):
+            assert layout.sum() < HEADS * b * b
+
+    def test_fixed_unidirectional_is_lower_triangular(self):
+        cfg = FixedSparsityConfig(HEADS, BLOCK, num_local_blocks=4,
+                                  attention="unidirectional")
+        layout = cfg.make_layout(SEQ)
+        b = SEQ // BLOCK
+        upper = np.triu(np.ones((b, b), np.int32), k=1)
+        assert (layout * upper[None]).sum() == 0
+
+    def test_bigbird_has_window_and_global(self):
+        cfg = BigBirdSparsityConfig(HEADS, BLOCK, num_random_blocks=0,
+                                    num_sliding_window_blocks=3,
+                                    num_global_blocks=1)
+        layout = cfg.make_layout(SEQ)
+        b = SEQ // BLOCK
+        for qi in range(1, b - 1):
+            assert layout[0, qi, qi - 1] and layout[0, qi, qi]
+        assert layout[0, :, 0].all()       # first block global col
+        assert layout[0, 0, :].all()       # ...and row (bidirectional)
+
+    def test_different_layout_per_head(self):
+        cfg = BigBirdSparsityConfig(HEADS, BLOCK, num_random_blocks=2,
+                                    different_layout_per_head=True)
+        layout = cfg.make_layout(SEQ)
+        assert any(not np.array_equal(layout[0], layout[h])
+                   for h in range(1, HEADS))
+
+    def test_kv_indices_roundtrip(self):
+        cfg = FixedSparsityConfig(HEADS, BLOCK, num_local_blocks=4)
+        layout = cfg.make_layout(SEQ)
+        idx, max_active = layout_kv_indices(layout)
+        b = SEQ // BLOCK
+        for qi in range(b):
+            cols = set(idx[0, qi][idx[0, qi] >= 0].tolist())
+            assert cols == set(np.nonzero(layout[0, qi])[0].tolist())
+
+
+class TestSparseExecution:
+    def _qkv(self, rng, seq=SEQ):
+        shape = (2, seq, HEADS, 32)
+        return tuple(jnp.asarray(rng.standard_normal(shape), jnp.float32)
+                     for _ in range(3))
+
+    def test_dense_layout_matches_full_attention(self):
+        rng = np.random.default_rng(0)
+        q, k, v = self._qkv(rng)
+        layout = DenseSparsityConfig(HEADS, BLOCK).make_layout(SEQ)
+        out = sparse_attention(q, k, v, layout, BLOCK, impl="xla")
+        ref = xla_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("cfg", _configs(),
+                             ids=lambda c: type(c).__name__)
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_matches_xla(self, cfg, causal):
+        rng = np.random.default_rng(1)
+        q, k, v = self._qkv(rng)
+        layout = cfg.make_layout(SEQ)
+        ref = sparse_attention(q, k, v, layout, BLOCK, causal=causal,
+                               impl="xla")
+        out = sparse_attention(q, k, v, layout, BLOCK, causal=causal,
+                               impl="pallas", interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_gradients_flow(self):
+        rng = np.random.default_rng(2)
+        q, k, v = self._qkv(rng)
+        layout = FixedSparsityConfig(HEADS, BLOCK).make_layout(SEQ)
+
+        def loss(q, k, v):
+            return jnp.sum(sparse_attention(q, k, v, layout, BLOCK,
+                                            impl="xla") ** 2)
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        assert all(np.isfinite(np.asarray(g)).all() for g in grads)
+
+    def test_sparse_self_attention_module(self):
+        rng = np.random.default_rng(3)
+        q, k, v = self._qkv(rng)
+        ssa = SparseSelfAttention(
+            FixedSparsityConfig(HEADS, BLOCK, num_local_blocks=4))
+        out = ssa(q, k, v)
+        assert out.shape == q.shape
+        # layout cached per seq_len
+        assert SEQ in ssa._layouts
+
+    def test_pad_to_block_size(self):
+        x = jnp.zeros((2, 100, 4, 8))
+        padded, pad = pad_to_block_size(x, 16)
+        assert pad == 12 and padded.shape[1] == 112
+        x2, pad2 = pad_to_block_size(jnp.zeros((2, 96, 4, 8)), 16)
+        assert pad2 == 0 and x2.shape[1] == 96
+
+    def test_layout_seq_mismatch_raises(self):
+        rng = np.random.default_rng(0)
+        q, k, v = self._qkv(rng, seq=128)
+        layout = DenseSparsityConfig(HEADS, BLOCK).make_layout(SEQ)
+        with pytest.raises(ValueError, match="layout"):
+            sparse_attention(q, k, v, layout, BLOCK)
