@@ -89,6 +89,23 @@ def compressed_allreduce_local(x: jax.Array,
     return result, new_worker_error, new_server_error
 
 
+def sync_momentum_compressed(m_local: jax.Array,
+                             worker_error: jax.Array,
+                             server_error: jax.Array,
+                             axis: str,
+                             n: int):
+    """Shared 1-bit momentum sync used by OneBitAdam/OneBitLamb: pad the
+    local momentum into the worker-error's aligned flat layout, run the
+    error-compensated allreduce, and reshape back. Must run inside a
+    data-manual shard_map region."""
+    numel = int(m_local.size)
+    flat = jnp.zeros(worker_error.shape[0], jnp.float32)
+    flat = flat.at[:numel].set(m_local.reshape(-1))
+    synced, we_new, se_new = compressed_allreduce_local(
+        flat, worker_error, server_error, axis, n)
+    return synced[:numel].reshape(m_local.shape), we_new, se_new
+
+
 def compressed_allreduce(x: jax.Array,
                          worker_error: jax.Array,
                          server_error: jax.Array,

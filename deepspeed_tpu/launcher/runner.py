@@ -65,7 +65,9 @@ def fetch_hostfile(path: str) -> "OrderedDict[str, int]":
                 continue
             try:
                 hostname, slots = line.split()
-                _, slot_count = slots.split("=")
+                key, slot_count = slots.split("=")
+                if key != "slots":
+                    raise ValueError
                 slot_count = int(slot_count)
             except ValueError:
                 raise ValueError(f"hostfile line malformed: '{line}' "

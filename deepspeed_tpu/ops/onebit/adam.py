@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.comm.compressed import compressed_allreduce_local
+from deepspeed_tpu.comm.compressed import sync_momentum_compressed
 from deepspeed_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -97,32 +97,36 @@ class OneBitAdam:
 
         def leaf(p, g, m, v, we, se):
             g = g.astype(jnp.float32)
-            numel = int(np.prod(p.shape) or 1)
             we2d, se2d = we.ndim == 2, se.ndim == 2
             if we2d:
                 we = we[0]
             if se2d:
                 se = se[0]
             if self.n > 1:
-                g_dense = jax.lax.pmean(g, self.axis)
+                # Phases gated with lax.cond on the (replicated) step counter
+                # so each step pays exactly ONE collective: dense pmean during
+                # warmup, the 1-bit all_to_all+allgather once frozen — the
+                # bandwidth saving that is the point of 1-bit optimizers
+                # (reference onebit/adam.py: freeze_step switches comm paths).
+                def warm_branch(g, m, v, we, se):
+                    g_dense = jax.lax.pmean(g, self.axis)
+                    m_new = self.b1 * m + (1 - self.b1) * g_dense
+                    v_new = self.b2 * v + (1 - self.b2) * g_dense**2
+                    return m_new, v_new, we, se
+
+                def comp_branch(g, m, v, we, se):
+                    m_local = self.b1 * m + (1 - self.b1) * g
+                    m_new, we_new, se_new = sync_momentum_compressed(
+                        m_local, we, se, self.axis, self.n)
+                    return m_new, v, we_new, se_new
+
+                m_new, v_new, we_new, se_new = jax.lax.cond(
+                    warm, warm_branch, comp_branch, g, m, v, we, se)
             else:
-                g_dense = g
-            # --- warmup: plain Adam moment updates on the dense average ---
-            m_warm = self.b1 * m + (1 - self.b1) * g_dense
-            v_new = jnp.where(warm, self.b2 * v + (1 - self.b2) * g_dense**2, v)
-            # --- compressed phase: local momentum + 1-bit allreduce -------
-            if self.n > 1:
-                m_local = self.b1 * m + (1 - self.b1) * g
-                flat = jnp.zeros(we.shape[0], jnp.float32).at[:numel].set(
-                    m_local.reshape(-1))
-                synced, we_new, se_new = compressed_allreduce_local(
-                    flat, we, se, self.axis, self.n)
-                m_comp = synced[:numel].reshape(p.shape)
-            else:
-                m_comp, we_new, se_new = m_warm, we, se
-            m_new = jnp.where(warm, m_warm, m_comp)
-            we_new = jnp.where(warm, we, we_new)
-            se_new = jnp.where(warm, se, se_new)
+                m_new = self.b1 * m + (1 - self.b1) * g
+                v_new = jnp.where(
+                    warm, self.b2 * v + (1 - self.b2) * g**2, v)
+                we_new, se_new = we, se
             if we2d:
                 we_new = we_new[None]
             if se2d:

@@ -1,8 +1,16 @@
 """1-bit LAMB (reference ``deepspeed/runtime/fp16/onebit/lamb.py``): the
 compressed-momentum scheme of 1-bit Adam plus LAMB's layerwise trust-ratio
-scaling. During warmup it is plain LAMB; in the compressed phase the frozen
-variance and the scaling factors learned during warmup keep the layerwise
-adaptivity while only 1-bit momentum crosses the wire."""
+scaling. During warmup it is plain LAMB and the per-param trust ratio is
+recorded every step; at ``freeze_step`` the variance AND the last recorded
+trust ratios freeze, and the compressed phase applies those frozen scaling
+coefficients — only 1-bit momentum crosses the wire (the reference likewise
+freezes per-layer ``scaling_coeff`` at the boundary rather than recomputing
+trust from sign-compressed momentum).
+
+The two phases are gated with ``lax.cond`` on the replicated step counter so
+each step pays exactly one collective family (dense ``pmean`` in warmup, the
+1-bit ``all_to_all``+``allgather`` afterwards).
+"""
 
 from typing import Any, NamedTuple
 
@@ -10,9 +18,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.comm.compressed import compressed_allreduce_local
-from deepspeed_tpu.ops.onebit.adam import OneBitState, _pad_len
+from deepspeed_tpu.comm.compressed import sync_momentum_compressed
+from deepspeed_tpu.ops.onebit.adam import _pad_len
 from deepspeed_tpu.parallel.mesh import DATA_AXIS
+
+
+class LambState(NamedTuple):
+    step: jax.Array
+    m: Any              # first moment (per-param tree)
+    v: Any              # second moment (frozen after warmup)
+    worker_error: Any   # flat error-feedback per param [padded numel]
+    server_error: Any   # flat server error per param [padded numel / n]
+    scale: Any          # per-param trust ratio (frozen after warmup)
 
 
 class OneBitLamb:
@@ -34,7 +51,7 @@ class OneBitLamb:
 
     def init(self, params):
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
-        return OneBitState(
+        return LambState(
             step=jnp.zeros((), jnp.int32),
             m=jax.tree_util.tree_map(zeros, params),
             v=jax.tree_util.tree_map(zeros, params),
@@ -45,60 +62,78 @@ class OneBitLamb:
             server_error=jax.tree_util.tree_map(
                 lambda p: jnp.zeros(
                     (self.n, _pad_len(int(np.prod(p.shape) or 1), self.n)
-                     // self.n), jnp.float32), params))
+                     // self.n), jnp.float32), params),
+            scale=jax.tree_util.tree_map(
+                lambda _: jnp.ones((), jnp.float32), params))
 
     def state_specs(self, params):
         from jax.sharding import PartitionSpec as P
 
         rep = jax.tree_util.tree_map(lambda _: P(), params)
         shard0 = jax.tree_util.tree_map(lambda _: P(self.axis), params)
-        return OneBitState(step=P(), m=rep, v=rep,
-                           worker_error=shard0, server_error=shard0)
+        return LambState(step=P(), m=rep, v=rep,
+                         worker_error=shard0, server_error=shard0, scale=rep)
 
-    def update(self, grads, state: OneBitState, params, lr=None):
+    def update(self, grads, state: LambState, params, lr=None):
         lr = self.lr if lr is None else lr
         step = state.step + 1
         t = step.astype(jnp.float32)
         warm = step <= self.freeze_step
 
-        def leaf(p, g, m, v, we, se):
+        def leaf(p, g, m, v, we, se, sc):
             g = g.astype(jnp.float32)
-            numel = int(np.prod(p.shape) or 1)
             we2d, se2d = we.ndim == 2, se.ndim == 2
             if we2d:
                 we = we[0]
             if se2d:
                 se = se[0]
-            g_dense = jax.lax.pmean(g, self.axis) if self.n > 1 else g
-            m_warm = self.b1 * m + (1 - self.b1) * g_dense
-            v_new = jnp.where(warm, self.b2 * v + (1 - self.b2) * g_dense**2, v)
+            bc1 = 1 - self.b1 ** t
+            bc2 = 1 - self.b2 ** t
+
+            def trust_of(pp, upd):
+                w_norm = jnp.linalg.norm(pp.reshape(-1))
+                u_norm = jnp.linalg.norm(upd.reshape(-1))
+                return jnp.where(
+                    (w_norm > 0) & (u_norm > 0),
+                    jnp.clip(w_norm / u_norm, 0.0, self.max_trust), 1.0)
+
+            def finish(m_new, v_new, we_new, se_new, sc_new):
+                upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
+                if self.weight_decay:
+                    upd = upd + self.weight_decay * p
+                return upd, m_new, v_new, we_new, se_new, sc_new
+
             if self.n > 1:
-                m_local = self.b1 * m + (1 - self.b1) * g
-                flat = jnp.zeros(we.shape[0], jnp.float32).at[:numel].set(
-                    m_local.reshape(-1))
-                synced, we_new, se_new = compressed_allreduce_local(
-                    flat, we, se, self.axis, self.n)
-                m_comp = synced[:numel].reshape(p.shape)
+                def warm_branch(g, m, v, we, se, sc):
+                    g_dense = jax.lax.pmean(g, self.axis)
+                    m_new = self.b1 * m + (1 - self.b1) * g_dense
+                    v_new = self.b2 * v + (1 - self.b2) * g_dense**2
+                    upd, *rest = finish(m_new, v_new, we, se, sc)
+                    trust = trust_of(p, upd)
+                    return (p - lr * trust * upd, *rest[:4], trust)
+
+                def comp_branch(g, m, v, we, se, sc):
+                    m_local = self.b1 * m + (1 - self.b1) * g
+                    m_new, we_new, se_new = sync_momentum_compressed(
+                        m_local, we, se, self.axis, self.n)
+                    upd, *rest = finish(m_new, v, we_new, se_new, sc)
+                    return (p - lr * sc * upd, *rest[:4], sc)
+
+                p_new, m_new, v_new, we_new, se_new, sc_new = jax.lax.cond(
+                    warm, warm_branch, comp_branch, g, m, v, we, se, sc)
             else:
-                m_comp, we_new, se_new = m_warm, we, se
-            m_new = jnp.where(warm, m_warm, m_comp)
-            we_new = jnp.where(warm, we, we_new)
-            se_new = jnp.where(warm, se, se_new)
+                m_new = self.b1 * m + (1 - self.b1) * g
+                v_new = jnp.where(
+                    warm, self.b2 * v + (1 - self.b2) * g**2, v)
+                upd, _, _, we_new, se_new, _ = finish(m_new, v_new, we, se, sc)
+                trust = trust_of(p, upd)
+                sc_new = jnp.where(warm, trust, sc)
+                p_new = p - lr * sc_new * upd
             if we2d:
                 we_new = we_new[None]
             if se2d:
                 se_new = se_new[None]
-            bc1 = 1 - self.b1 ** t
-            bc2 = 1 - self.b2 ** t
-            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
-            if self.weight_decay:
-                upd = upd + self.weight_decay * p
-            w_norm = jnp.linalg.norm(p.reshape(-1))
-            u_norm = jnp.linalg.norm(upd.reshape(-1))
-            trust = jnp.where((w_norm > 0) & (u_norm > 0),
-                              jnp.clip(w_norm / u_norm, 0.0, self.max_trust),
-                              1.0)
-            return p - lr * trust * upd, m_new, v_new, we_new, se_new
+            return p_new, m_new, v_new, we_new, se_new, sc_new
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         out = [leaf(*args) for args in zip(
@@ -107,9 +142,11 @@ class OneBitLamb:
             treedef.flatten_up_to(state.m),
             treedef.flatten_up_to(state.v),
             treedef.flatten_up_to(state.worker_error),
-            treedef.flatten_up_to(state.server_error))]
+            treedef.flatten_up_to(state.server_error),
+            treedef.flatten_up_to(state.scale))]
         unflat = lambda i: jax.tree_util.tree_unflatten(
             treedef, [o[i] for o in out])
-        new_state = OneBitState(step=step, m=unflat(1), v=unflat(2),
-                                worker_error=unflat(3), server_error=unflat(4))
+        new_state = LambState(step=step, m=unflat(1), v=unflat(2),
+                              worker_error=unflat(3), server_error=unflat(4),
+                              scale=unflat(5))
         return unflat(0), new_state

@@ -193,8 +193,11 @@ class TrainSchedule(PipeSchedule):
 
     def num_pipe_buffers(self):
         """Max outstanding microbatches for this stage (reference :277):
-        earlier stages hold more in-flight forwards."""
-        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        earlier stages hold more in-flight forwards. The +1 matches the
+        reference sizing so a forward landing on the same tick as a SendGrad
+        never shares that microbatch's buffer — safe even for an executor
+        with asynchronous sends."""
+        buffers = min(self.stages - self.stage_id + 1, self.micro_batches)
         return max(2, buffers)
 
     def _buffer_idx(self, micro_batch_id: int) -> int:
