@@ -58,9 +58,12 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         causal_mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
         logits = jnp.where(causal_mask[None, None], logits, neg)
     if mask is not None:
-        # mask: broadcastable to [B, H, Sq, Sk]; True/1 = attend.
-        while mask.ndim < 4:
-            mask = mask[:, None] if mask.ndim == 3 else mask[None]
+        # mask: [B, Sk] key-padding, or broadcastable to [B, H, Sq, Sk];
+        # True/1 = attend.
+        if mask.ndim == 2:
+            mask = mask[:, None, None, :]
+        elif mask.ndim == 3:
+            mask = mask[:, None]
         logits = jnp.where(mask.astype(jnp.bool_), logits, neg)
     probs = jax.nn.softmax(logits, axis=-1)
     if dropout_rate > 0.0 and not deterministic:
@@ -72,8 +75,27 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return out
 
 
+def _as_kv_mask(mask, batch, sk):
+    """Extract a key-padding mask [B, Sk] from the common mask forms, or
+    None if the mask is a general [B, H, Sq, Sk] pattern the flash kernel
+    cannot take."""
+    if mask is None:
+        return None
+    if mask.ndim == 2 and mask.shape == (batch, sk):
+        return mask
+    if (mask.ndim == 4 and mask.shape[0] == batch and mask.shape[1] == 1
+            and mask.shape[2] == 1 and mask.shape[3] == sk):
+        return mask[:, 0, 0, :]
+    if (mask.ndim == 3 and mask.shape[0] == batch and mask.shape[1] == 1
+            and mask.shape[2] == sk):
+        return mask[:, 0, :]
+    return None
+
+
 def _pallas_ok(q, k, causal, bias, mask, dropout_rate, deterministic):
-    if bias is not None or mask is not None:
+    if bias is not None:
+        return False
+    if mask is not None and _as_kv_mask(mask, q.shape[0], k.shape[1]) is None:
         return False
     if dropout_rate > 0.0 and not deterministic:
         return False
@@ -97,16 +119,18 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         impl = ("pallas" if _on_tpu() and _pallas_ok(
             q, k, causal, bias, mask, dropout_rate, deterministic) else "xla")
     if impl == "pallas":
-        if bias is not None or mask is not None:
-            raise ValueError("impl='pallas' flash attention does not take "
-                             "mask/bias yet — use impl='xla' (or sparse "
-                             "attention for layout masks)")
+        kv_mask = _as_kv_mask(mask, q.shape[0], k.shape[1])
+        if bias is not None or (mask is not None and kv_mask is None):
+            raise ValueError("impl='pallas' flash attention takes only "
+                             "key-padding masks ([B, Sk] / [B,1,1,Sk]) — "
+                             "use impl='xla' for general masks/bias (or "
+                             "sparse attention for layout masks)")
         if dropout_rate > 0.0 and not deterministic:
             raise ValueError("impl='pallas' flash attention does not apply "
                              "attention dropout — use impl='xla'")
         from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal,
+        return flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
                                softmax_scale=softmax_scale)
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, bias=bias, mask=mask,

@@ -40,9 +40,13 @@ def _use_interpret() -> bool:
 # Forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                causal: bool, scale: float, block_k: int, seq_q: int,
-                seq_k: int):
+def _fwd_kernel(*refs, causal: bool, scale: float, block_k: int, seq_q: int,
+                seq_k: int, has_mask: bool):
+    if has_mask:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        mask_ref = None
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
@@ -73,6 +77,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         m_cur = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
+        if mask_ref is not None:
+            # Key-padding mask (float 0/1, [1, bk]): multiplying p keeps the
+            # masked keys out of BOTH the normaliser and the accumulator —
+            # exact, and robust even for fully-masked rows (p -> 0, l -> 0).
+            km = mask_ref[0, :, pl.ds(ki * block_k, block_k)]
+            p = p * km
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1)
         acc = acc * alpha[:, None] + jnp.dot(
@@ -89,20 +99,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, LANES))
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
+                   interpret, nheads=1):
     bh, sq, d = q.shape
     sk = k.shape[1]
     grid = (bh, sq // block_q)
+    has_mask = kv_mask is not None
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                               block_k=block_k, seq_q=sq, seq_k=sk)
+                               block_k=block_k, seq_q=sq, seq_k=sk,
+                               has_mask=has_mask)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+    ]
+    inputs = [q, k, v]
+    if has_mask:
+        # Mask rides as [B, 1, Sk] so the (1, 1, Sk) block's trailing dims
+        # equal the array's (TPU mosaic tiling constraint for sub-8 rows).
+        in_specs.append(
+            pl.BlockSpec((1, 1, sk), lambda b, i: (b // nheads, 0, 0)))
+        inputs.append(kv_mask)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
@@ -112,7 +133,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
@@ -120,9 +141,14 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 # Backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   causal: bool, scale: float, block_k: int, seq_q: int,
-                   seq_k: int):
+def _bwd_dq_kernel(*refs, causal: bool, scale: float, block_k: int,
+                   seq_q: int, seq_k: int, has_mask: bool):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dq_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+        mask_ref = None
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
@@ -151,6 +177,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_idx + offset >= k_idx, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
+        if mask_ref is not None:
+            p = p * mask_ref[0, :, pl.ds(ki * block_k, block_k)]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
@@ -160,10 +188,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *,
-                    causal: bool, scale: float, block_q: int, seq_q: int,
-                    seq_k: int):
+def _bwd_dkv_kernel(*refs, causal: bool, scale: float, block_q: int,
+                    seq_q: int, seq_k: int, has_mask: bool):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref) = refs
+        mask_ref = None
     ki = pl.program_id(1)
     block_k = k_ref.shape[1]
     d = k_ref.shape[2]
@@ -192,6 +225,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_idx + offset >= k_idx, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                       # [bq, bk]
+        if mask_ref is not None:
+            p = p * mask_ref[0]
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -209,43 +244,59 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(res, g, causal, scale, block_q, block_k, interpret):
-    q, k, v, out, lse = res
+def _flash_backward(res, g, causal, scale, block_q, block_k, interpret,
+                    nheads=1):
+    q, k, v, kv_mask, out, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
+    has_mask = kv_mask is not None
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+    ]
+    dq_inputs = [q, k, v, do, lse, delta]
+    if has_mask:
+        dq_in_specs.append(
+            pl.BlockSpec((1, 1, sk), lambda b, i: (b // nheads, 0, 0)))
+        dq_inputs.append(kv_mask)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
-                          block_k=block_k, seq_q=sq, seq_k=sk),
+                          block_k=block_k, seq_q=sq, seq_k=sk,
+                          has_mask=has_mask),
         grid=(bh, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_inputs)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, sq, LANES), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, sq, LANES), lambda b, i: (b, 0, 0)),
+    ]
+    dkv_inputs = [q, k, v, do, lse, delta]
+    if has_mask:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, i: (b // nheads, 0, i)))
+        dkv_inputs.append(kv_mask)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
-                          block_q=block_q, seq_q=sq, seq_k=sk),
+                          block_q=block_q, seq_q=sq, seq_k=sk,
+                          has_mask=has_mask),
         grid=(bh, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sq, LANES), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sq, LANES), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
@@ -255,7 +306,7 @@ def _flash_backward(res, g, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
@@ -265,14 +316,15 @@ def _flash_backward(res, g, causal, scale, block_q, block_k, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_bhsd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, None, causal, scale, block_q, block_k,
+                            interpret)
     return out
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+    out, lse = _flash_forward(q, k, v, None, causal, scale, block_q, block_k,
                               interpret)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, None, out, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
@@ -282,13 +334,44 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_bhsd_masked(q, k, v, kv_mask, causal, scale, block_q, block_k,
+                       interpret, nheads):
+    out, _ = _flash_forward(q, k, v, kv_mask, causal, scale, block_q,
+                            block_k, interpret, nheads)
+    return out
+
+
+def _flash_fwd_rule_masked(q, k, v, kv_mask, causal, scale, block_q, block_k,
+                           interpret, nheads):
+    out, lse = _flash_forward(q, k, v, kv_mask, causal, scale, block_q,
+                              block_k, interpret, nheads)
+    return out, (q, k, v, kv_mask, out, lse)
+
+
+def _flash_bwd_rule_masked(causal, scale, block_q, block_k, interpret, nheads,
+                           res, g):
+    dq, dk, dv = _flash_backward(res, g, causal, scale, block_q, block_k,
+                                 interpret, nheads)
+    return dq, dk, dv, jnp.zeros_like(res[3])
+
+
+_flash_bhsd_masked.defvjp(_flash_fwd_rule_masked, _flash_bwd_rule_masked)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False,
+                    kv_mask: Optional[jax.Array] = None,
                     softmax_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """Flash attention over [batch, seq, heads, head_dim] tensors."""
+    """Flash attention over [batch, seq, heads, head_dim] tensors.
+
+    ``kv_mask``: optional key-padding mask [batch, seq_k], 1/True = attend —
+    the fused-kernel answer to the reference's attention-mask input
+    (csrc/transformer/softmax_kernels.cu applies it inside attn_softmax).
+    """
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -302,6 +385,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v),
-                      causal, scale, block_q, block_k, interpret)
+    if kv_mask is not None:
+        if kv_mask.shape != (b, sk):
+            raise ValueError(f"kv_mask shape {kv_mask.shape} != {(b, sk)}")
+        out = _flash_bhsd_masked(
+            to_bhsd(q), to_bhsd(k), to_bhsd(v),
+            kv_mask.astype(jnp.float32)[:, None, :],
+            causal, scale, block_q, block_k, interpret, h)
+    else:
+        out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                          causal, scale, block_q, block_k, interpret)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

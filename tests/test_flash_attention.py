@@ -79,6 +79,78 @@ class TestCrossLength:
                                        err_msg=f"d{n}")
 
 
+class TestKvMask:
+    """Key-padding mask parity (the BERT attention_mask path): masked keys
+    must contribute to neither the normaliser nor the output, matching the
+    xla reference's where-on-logits semantics."""
+
+    def _mask(self, rng, b, s):
+        lengths = rng.integers(1, s + 1, (b,))
+        return jnp.asarray(np.arange(s)[None, :] < lengths[:, None],
+                           jnp.int32)
+
+    @pytest.mark.parametrize("b,s,h,d,causal", GRID)
+    def test_forward(self, b, s, h, d, causal):
+        rng = np.random.default_rng(4)
+        q, k, v = _make_qkv(rng, b, s, h, d)
+        km = self._mask(rng, b, s)
+        ref = xla_attention(q, k, v, causal=causal,
+                            mask=km[:, None, None, :])
+        out = flash_attention(q, k, v, causal=causal, kv_mask=km,
+                              interpret=True)
+        # Padded QUERY rows may differ (flash never sees query masks; the
+        # model multiplies them out downstream) — compare valid rows only.
+        valid = np.asarray(km, bool)
+        np.testing.assert_allclose(np.asarray(out)[valid],
+                                   np.asarray(ref)[valid],
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_all_ones_mask_matches_unmasked(self):
+        rng = np.random.default_rng(5)
+        q, k, v = _make_qkv(rng, 2, 128, 2, 64)
+        km = jnp.ones((2, 128), jnp.int32)
+        out_m = flash_attention(q, k, v, kv_mask=km, interpret=True)
+        out = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(out_m), np.asarray(out),
+                                   atol=1e-6, rtol=1e-6)
+
+    def test_grads(self):
+        rng = np.random.default_rng(6)
+        b, s, h, d = 2, 128, 2, 64
+        q, k, v = _make_qkv(rng, b, s, h, d)
+        km = self._mask(rng, b, s)
+        valid = np.asarray(km, bool)
+        # Zero the cotangent on padded query rows so both sides see the
+        # same upstream gradient on rows the model would keep.
+        w = jnp.asarray(valid, jnp.float32)[:, :, None, None]
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, kv_mask=km, interpret=True)
+            return jnp.sum((o * w) ** 2)
+
+        def loss_ref(q, k, v):
+            o = xla_attention(q, k, v, mask=km[:, None, None, :])
+            return jnp.sum((o * w) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                       atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name} mismatch")
+
+
+class TestDispatchMask:
+    def test_pallas_accepts_padding_mask_forms(self):
+        from deepspeed_tpu.ops.transformer.attention import _as_kv_mask
+        m2 = jnp.ones((2, 128))
+        assert _as_kv_mask(m2, 2, 128) is m2
+        m4 = jnp.ones((2, 1, 1, 128))
+        assert _as_kv_mask(m4, 2, 128).shape == (2, 128)
+        full = jnp.ones((2, 4, 128, 128))
+        assert _as_kv_mask(full, 2, 128) is None
+
+
 class TestFlashBackward:
     @pytest.mark.parametrize("b,s,h,d,causal", GRID)
     def test_grads_match_reference(self, b, s, h, d, causal):
