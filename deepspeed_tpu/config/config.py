@@ -221,6 +221,13 @@ class DeepSpeedTPUConfig:
         self.mesh = MeshConfig.from_dict(d.get(C.MESH))
         self.data_parallel_size = self.mesh.resolve_data(self.world_size)
 
+        # --- elasticity: takes control of the batch triple when enabled ------------
+        # (reference runtime/config.py:679-733)
+        self.elasticity = dict(d.get(C.ELASTICITY, {}))
+        self.elasticity_enabled = bool(self.elasticity.get("enabled", False))
+        if self.elasticity_enabled:
+            self._apply_elasticity(d)
+
         # --- batch triple ----------------------------------------------------------
         micro = d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
                       d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_CHIP))
@@ -277,7 +284,6 @@ class DeepSpeedTPUConfig:
         self.pipeline = dict(d.get(C.PIPELINE, {}))
         self.eigenvalue = dict(d.get(C.EIGENVALUE, {}))
         self.quantize_training = dict(d.get(C.QUANTIZE_TRAINING, {}))
-        self.elasticity = dict(d.get(C.ELASTICITY, {}))
 
         # --- misc ------------------------------------------------------------------
         self.steps_per_print = int(_get(d, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT))
@@ -292,6 +298,35 @@ class DeepSpeedTPUConfig:
         self._validate()
 
     # ------------------------------------------------------------------
+    def _apply_elasticity(self, d: Dict[str, Any]) -> None:
+        """Let the elastic config own the batch triple (reference
+        runtime/config.py:679-733): compute (batch, micro, gas) for the
+        current world size and write them into the param dict."""
+        from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                              compute_elastic_config,
+                                              ensure_immutable_elastic_config)
+        from deepspeed_tpu.utils.logging import logger
+        from deepspeed_tpu.version import __version__
+
+        final_batch, valid, micro = compute_elastic_config(
+            d, __version__, world_size=self.world_size)
+        ensure_immutable_elastic_config(self.elasticity)
+        batch_keys = (C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                      C.GRADIENT_ACCUMULATION_STEPS)
+        if not self.elasticity.get("ignore_non_elastic_batch_info", False):
+            if any(k in d for k in batch_keys):
+                raise ElasticityConfigError(
+                    "batch parameters found in config but elastic training "
+                    "controls them; set "
+                    "'ignore_non_elastic_batch_info': true to silence")
+        gas = final_batch // (micro * self.world_size)
+        logger.info("[Elasticity] batch=%d micro=%d gas=%d valid chip "
+                    "counts: %s", final_batch, micro, gas, valid)
+        d[C.TRAIN_BATCH_SIZE] = final_batch
+        d[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro
+        d[C.GRADIENT_ACCUMULATION_STEPS] = gas
+        self.elastic_valid_world_sizes = valid
+
     @staticmethod
     def _default_world() -> int:
         try:

@@ -1,0 +1,22 @@
+"""Elastic training config math (reference ``deepspeed/elasticity/``)."""
+
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+    highly_composite_numbers,
+)
+
+# Reference exposes errors under deepspeed.elasticity.config as well.
+from deepspeed_tpu.elasticity import elasticity as config  # noqa: F401
+
+__all__ = [
+    "ElasticityConfig", "ElasticityConfigError", "ElasticityError",
+    "ElasticityIncompatibleWorldSize", "compute_elastic_config",
+    "elasticity_enabled", "ensure_immutable_elastic_config",
+    "highly_composite_numbers", "config",
+]

@@ -1,0 +1,41 @@
+"""``ds-elastic-tpu`` CLI — inspect an elastic config (reference ``bin/ds_elastic``).
+
+Prints the computed total batch size and valid chip counts for a config
+file, optionally resolving the micro batch for a given world size.
+"""
+
+import argparse
+import json
+
+from deepspeed_tpu.elasticity import compute_elastic_config
+from deepspeed_tpu.version import __version__
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Inspect DeepSpeed-TPU elastic config batch math")
+    parser.add_argument("-c", "--config", required=True,
+                        help="DeepSpeed-TPU JSON config file")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="Resolve micro batch for this chip count")
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        ds_config = json.load(f)
+
+    result = compute_elastic_config(ds_config, __version__,
+                                    world_size=args.world_size)
+    if args.world_size > 0:
+        batch, valid, micro = result
+        print(f"train_batch_size: {batch}")
+        print(f"micro_batch_size @ world={args.world_size}: {micro}")
+        print(f"gradient_accumulation_steps: "
+              f"{batch // (args.world_size * micro)}")
+    else:
+        batch, valid = result
+        print(f"train_batch_size: {batch}")
+    print(f"valid chip counts ({len(valid)}): {valid}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,252 @@
+"""Elastic-training config math (reference ``deepspeed/elasticity/elasticity.py``).
+
+Given an elasticity block, compute a total train batch size plus the list of
+chip counts the job can scale across *without* changing convergence — the
+batch decomposes as ``micro_batch x grad_accum x world`` for every valid
+world size (reference ``compute_elastic_config`` at elasticity.py:226,
+``_get_compatible_gpus_v01`` at :124).
+
+TPU-native notes: "GPUs" in the reference become chips here; on TPU the
+realistic world sizes are slice shapes (multiples of 4/8), which the
+``min_chips``/``max_chips`` bounds express. The highly-composite-number
+ladder is *generated* (prime-exponent recursion) instead of hardcoded, so
+arbitrary ``max_train_batch_size`` values are supported.
+"""
+
+import json
+import os
+from functools import lru_cache
+from math import lcm
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.version import __version__
+
+ELASTICITY_KEY = "elasticity"
+LATEST_ELASTICITY_VERSION = 0.1
+# Elasticity semantics are stable since the first release of this framework.
+MINIMUM_FRAMEWORK_VERSION = "0.1.0"
+# Env var through which the resource scheduler pins the elastic config it
+# scheduled against (reference constants.py DEEPSPEED_ELASTICITY_CONFIG).
+ELASTICITY_ENV = "DEEPSPEED_ELASTICITY_CONFIG"
+
+
+class ElasticityError(Exception):
+    """Base exception for elasticity."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Malformed/missing elasticity configuration."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size not in the valid chip-count list of the elastic config."""
+
+
+class ElasticityConfig:
+    """Typed view of the ``elasticity`` config block (reference
+    ``elasticity/config.py:30``). Accepts both the reference's ``*_gpus``
+    keys and TPU-flavoured ``*_chips`` aliases."""
+
+    def __init__(self, d: Dict):
+        self.enabled = bool(d.get("enabled", False))
+        if self.enabled:
+            if "max_train_batch_size" not in d:
+                raise ElasticityConfigError(
+                    "elasticity config missing 'max_train_batch_size'")
+            if "micro_batch_sizes" not in d:
+                raise ElasticityConfigError(
+                    "elasticity config missing 'micro_batch_sizes'")
+        self.max_acceptable_batch_size = int(d.get("max_train_batch_size", 2000))
+        self.micro_batches = d.get("micro_batch_sizes", [2, 4, 6])
+        if not isinstance(self.micro_batches, (list, tuple)) or \
+                not self.micro_batches:
+            raise ElasticityConfigError(
+                f"'micro_batch_sizes' must be a non-empty list, got "
+                f"{self.micro_batches!r}")
+        if not all(isinstance(m, int) and m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"'micro_batch_sizes' must be positive ints, got "
+                f"{self.micro_batches!r}")
+        self.micro_batches = list(self.micro_batches)
+        self.min_chips = int(d.get("min_chips", d.get("min_gpus", 1)))
+        self.max_chips = int(d.get("max_chips", d.get("max_gpus", 10000)))
+        self.min_time = int(d.get("min_time", 0))
+        self.prefer_larger_batch_size = bool(d.get("prefer_larger_batch", True))
+        self.ignore_non_elastic_batch_info = bool(
+            d.get("ignore_non_elastic_batch_info", False))
+        self.version = float(d.get("version", LATEST_ELASTICITY_VERSION))
+
+    def repr_dict(self) -> Dict:
+        return {
+            "enabled": self.enabled,
+            "max_train_batch_size": self.max_acceptable_batch_size,
+            "micro_batch_sizes": self.micro_batches,
+            "min_chips": self.min_chips,
+            "max_chips": self.max_chips,
+            "version": self.version,
+        }
+
+
+@lru_cache(maxsize=None)
+def highly_composite_numbers(limit: int) -> Tuple[int, ...]:
+    """All highly composite numbers <= limit, generated.
+
+    A HCN has a prime factorisation over the first k primes with
+    non-increasing exponents; enumerate that (small) candidate set and keep
+    the divisor-count records. Replaces the reference's 38-entry hardcoded
+    table (elasticity.py:21) and extends past its 720720 ceiling.
+    """
+    primes = [2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37]
+    candidates: List[Tuple[int, int]] = []  # (value, n_divisors)
+
+    def rec(i: int, value: int, max_exp: int, ndiv: int):
+        candidates.append((value, ndiv))
+        if i >= len(primes):
+            return
+        p, v, e = primes[i], value, 0
+        while e < max_exp:
+            v *= p
+            if v > limit:
+                break
+            e += 1
+            rec(i + 1, v, e, ndiv * (e + 1))
+
+    rec(0, 1, 64, 1)
+    best = 0
+    out = []
+    for value, ndiv in sorted(candidates):
+        if ndiv > best:
+            best = ndiv
+            out.append(value)
+    return tuple(out)
+
+
+def _scaled_candidates(bases: Sequence[int], max_batch: int) -> List[int]:
+    """For each base batch, the largest ``base * hcn`` <= max_batch
+    (reference get_candidate_batch_sizes, elasticity.py:64)."""
+    hcns = highly_composite_numbers(max_batch)
+    out = set()
+    for base in bases:
+        scale = 1
+        for h in hcns:
+            if base * h > max_batch:
+                break
+            scale = h
+        out.add(base * scale)
+    return sorted(out)
+
+
+def _valid_world_sizes(batch: int, micro_batches: Sequence[int],
+                       lo: int, hi: int) -> List[int]:
+    """Chip counts w in [lo, hi] such that batch = mb * gas * w exactly for
+    some configured micro batch (reference get_valid_gpus, elasticity.py:79):
+    every divisor of batch//mb is a valid world size."""
+    valid = set()
+    for mb in micro_batches:
+        if batch % mb:
+            continue
+        q = batch // mb
+        d = 1
+        while d * d <= q:
+            if q % d == 0:
+                for w in (d, q // d):
+                    if lo <= w <= hi:
+                        valid.add(w)
+            d += 1
+    return sorted(valid)
+
+
+def _best_batch(micro_batches: Sequence[int], max_batch: int,
+                min_chips: int, max_chips: int,
+                prefer_larger: bool) -> Tuple[int, List[int]]:
+    """Pick the candidate batch with the most valid chip counts
+    (reference _get_compatible_gpus_v01, elasticity.py:124)."""
+    if any(mb > max_batch for mb in micro_batches):
+        raise ElasticityConfigError(
+            f"all micro batches must be <= max_train_batch_size={max_batch}")
+    bases = list(micro_batches) + [lcm(*micro_batches)]
+    best_batch, best_valid = min(micro_batches), []
+    for cand in _scaled_candidates(bases, max_batch):
+        valid = _valid_world_sizes(cand, micro_batches, min_chips, max_chips)
+        better = len(valid) > len(best_valid) or (
+            len(valid) == len(best_valid) and
+            (cand > best_batch if prefer_larger else cand < best_batch))
+        if better:
+            best_batch, best_valid = cand, valid
+    return best_batch, best_valid
+
+
+def _version_tuple(v: str) -> Tuple[int, ...]:
+    parts = []
+    for tok in str(v).split("."):
+        digits = "".join(ch for ch in tok if ch.isdigit())
+        parts.append(int(digits) if digits else 0)
+    return tuple(parts)
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    return bool(ds_config.get(ELASTICITY_KEY, {}).get("enabled", False))
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict) -> None:
+    """Cross-check the runtime elastic config against the one the resource
+    scheduler used (env ``DEEPSPEED_ELASTICITY_CONFIG``); they must agree on
+    batch math or scaling decisions are invalid (reference elasticity.py:193)."""
+    if ELASTICITY_ENV not in os.environ:
+        logger.warning(
+            "%s not set: resource scheduler cannot be verified to scale this "
+            "job with compatible chip counts", ELASTICITY_ENV)
+        return
+    sched = ElasticityConfig(json.loads(os.environ[ELASTICITY_ENV]))
+    run = ElasticityConfig(runtime_elastic_config_dict)
+    for field in ("max_acceptable_batch_size", "micro_batches", "version"):
+        if getattr(sched, field) != getattr(run, field):
+            raise ElasticityConfigError(
+                f"elastic config mismatch between scheduler and runtime on "
+                f"{field}: {getattr(sched, field)} != {getattr(run, field)}")
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str,
+                           world_size: int = 0):
+    """Compute (final_batch_size, valid_chip_counts[, micro_batch]) for an
+    elastic job (reference compute_elastic_config, elasticity.py:226).
+
+    ``world_size > 0`` additionally resolves the largest configured micro
+    batch compatible with that world size and returns it as a third value.
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError(f"expected dict config, got {type(ds_config)}")
+    if ELASTICITY_KEY not in ds_config:
+        raise ElasticityConfigError(
+            f"'{ELASTICITY_KEY}' missing from config — add it to run elastic")
+    ecfg = ElasticityConfig(ds_config[ELASTICITY_KEY])
+    if not ecfg.enabled:
+        raise ElasticityConfigError("elasticity is disabled in config")
+    if ecfg.version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity version {ecfg.version} unsupported "
+            f"(latest {LATEST_ELASTICITY_VERSION})")
+    if _version_tuple(target_deepspeed_version) < _version_tuple(
+            MINIMUM_FRAMEWORK_VERSION):
+        raise ElasticityError(
+            f"target version {target_deepspeed_version} < minimum "
+            f"{MINIMUM_FRAMEWORK_VERSION} supporting elasticity "
+            f"(current {__version__})")
+
+    final_batch, valid = _best_batch(
+        ecfg.micro_batches, ecfg.max_acceptable_batch_size,
+        ecfg.min_chips, ecfg.max_chips, ecfg.prefer_larger_batch_size)
+
+    if world_size > 0:
+        if world_size not in valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in valid chip counts {valid}")
+        micro = next((mb for mb in sorted(set(ecfg.micro_batches), reverse=True)
+                      if (final_batch // world_size) % mb == 0), None)
+        if micro is None:
+            raise ElasticityError(
+                f"no configured micro batch divides "
+                f"{final_batch}//{world_size}")
+        return final_batch, valid, micro
+    return final_batch, valid

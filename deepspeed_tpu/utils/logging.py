@@ -34,7 +34,9 @@ class _LoggerFactory:
                 "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
                 datefmt="%Y-%m-%d %H:%M:%S",
             )
-            handler = logging.StreamHandler(stream=sys.stdout)
+            # stderr, not stdout: tools in this package (bench.py, CLI
+            # scripts) reserve stdout for machine-readable output.
+            handler = logging.StreamHandler(stream=sys.stderr)
             handler.setFormatter(formatter)
             log.addHandler(handler)
         return log

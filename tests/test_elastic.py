@@ -1,0 +1,122 @@
+"""Elasticity config math tests (reference ``tests/unit/test_elastic.py``)."""
+
+import pytest
+
+from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+from deepspeed_tpu.elasticity import (
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    highly_composite_numbers,
+)
+from deepspeed_tpu.version import __version__
+
+
+def base_config():
+    return {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 10000,
+            "micro_batch_sizes": [8, 12, 16, 17],
+            "min_gpus": 32,
+            "max_gpus": 1500,
+            "min_time": 20,
+            "version": 0.1,
+        }
+    }
+
+
+def test_basic_10k():
+    # The reference's canonical case (test_elastic.py:23): 9792 with 23
+    # valid chip counts.
+    batch, valid = compute_elastic_config(base_config(), __version__)
+    assert batch == 9792
+    assert len(valid) == 23
+    micro_batches = base_config()["elasticity"]["micro_batch_sizes"]
+    for w in valid:
+        assert batch % w == 0
+        assert any((batch // w) % mb == 0 for mb in micro_batches)
+
+
+def test_hcn_generation_matches_known_sequence():
+    # First entries of the true HCN sequence (the reference hardcodes these,
+    # elasticity.py:21; we generate them).
+    assert highly_composite_numbers(720720) == (
+        1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+        1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720,
+        45360, 50400, 55440, 83160, 110880, 166320, 221760, 277200,
+        332640, 498960, 554400, 665280, 720720)
+
+
+def test_old_version():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(base_config(), "0.0.1")
+
+
+def test_disabled():
+    cfg = base_config()
+    cfg["elasticity"]["enabled"] = False
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg, __version__)
+
+
+def test_valid_world_size():
+    batch, valid, micro = compute_elastic_config(
+        base_config(), __version__, world_size=64)
+    assert micro == 17
+
+
+def test_invalid_world_size():
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(base_config(), __version__, world_size=128)
+
+
+def test_future_elastic_version():
+    cfg = base_config()
+    cfg["elasticity"]["version"] = "0.2"
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(cfg, __version__)
+
+
+def test_missing_max_batch():
+    cfg = base_config()
+    del cfg["elasticity"]["max_train_batch_size"]
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(cfg, __version__)
+
+
+def test_missing_micro_batch():
+    cfg = base_config()
+    del cfg["elasticity"]["micro_batch_sizes"]
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(cfg, __version__)
+
+
+def test_non_list_micro_batch():
+    cfg = base_config()
+    cfg["elasticity"]["micro_batch_sizes"] = 8
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg, __version__)
+
+
+def test_config_takes_over_batch_triple():
+    # DeepSpeedTPUConfig with elasticity enabled at a valid world size
+    # derives the batch triple from the elastic config.
+    cfg = base_config()
+    ds = DeepSpeedTPUConfig(cfg, world_size=64)
+    assert ds.elasticity_enabled
+    assert ds.train_batch_size == 9792
+    assert ds.train_micro_batch_size_per_gpu == 17
+    assert ds.gradient_accumulation_steps == 9792 // (17 * 64)
+    assert 64 in ds.elastic_valid_world_sizes
+
+
+def test_config_rejects_external_batch_info():
+    cfg = base_config()
+    cfg["train_batch_size"] = 1024
+    with pytest.raises(ElasticityConfigError):
+        DeepSpeedTPUConfig(cfg, world_size=64)
+    cfg["elasticity"]["ignore_non_elastic_batch_info"] = True
+    ds = DeepSpeedTPUConfig(cfg, world_size=64)
+    assert ds.train_batch_size == 9792
