@@ -312,6 +312,7 @@ class DeepSpeedTPUConfig:
             d, __version__, world_size=self.world_size)
         ensure_immutable_elastic_config(self.elasticity)
         batch_keys = (C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                      C.TRAIN_MICRO_BATCH_SIZE_PER_CHIP,
                       C.GRADIENT_ACCUMULATION_STEPS)
         if not self.elasticity.get("ignore_non_elastic_batch_info", False):
             if any(k in d for k in batch_keys):

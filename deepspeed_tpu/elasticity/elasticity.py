@@ -128,6 +128,8 @@ def _scaled_candidates(bases: Sequence[int], max_batch: int) -> List[int]:
     hcns = highly_composite_numbers(max_batch)
     out = set()
     for base in bases:
+        if base > max_batch:
+            continue  # e.g. lcm(micro_batches) itself exceeds the cap
         scale = 1
         for h in hcns:
             if base * h > max_batch:

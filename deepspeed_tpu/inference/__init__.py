@@ -1,0 +1,16 @@
+"""Inference subsystem — TP-sharded, KV-cached, optionally int8-quantized.
+
+Reference surface: ``deepspeed/inference/engine.py``,
+``deepspeed/module_inject/`` and ``runtime/weight_quantizer.py``.
+"""
+
+from deepspeed_tpu.inference.engine import InferenceConfig, InferenceEngine
+from deepspeed_tpu.inference.quantization import (QuantizedWeight,
+                                                  dequantize_params,
+                                                  quantize_params,
+                                                  quantized_nbytes)
+
+__all__ = [
+    "InferenceEngine", "InferenceConfig", "quantize_params",
+    "dequantize_params", "QuantizedWeight", "quantized_nbytes",
+]

@@ -1,0 +1,109 @@
+"""Int8 weight-only quantization for inference.
+
+TPU-native analogue of the reference's ``WeightQuantization``
+(``deepspeed/runtime/weight_quantizer.py:5``) and the int8 inference path of
+``replace_module``: weights are stored in HBM as int8 with per-output-channel
+(optionally row-groupwise) fp32 scales, halving (vs bf16) or quartering (vs
+fp32) weight memory. Dequantization happens *inside* the jitted forward —
+XLA fuses the ``int8 → bf16 × scale`` expansion into the consuming matmul's
+operand pipeline, so no dequantized copy of the full model ever lives in HBM
+at once.
+
+Symmetric linear quantization, matching the reference's quantizer semantics
+(``csrc/quantization/quantizer.cu``): ``q = round(w / s)``, ``s = max|w| /
+127`` per (group, output-channel).
+"""
+
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedWeight:
+    """An int8 tensor + fp32 scales standing in for a float weight leaf.
+
+    Registered as a pytree node so quantized param trees pass through
+    ``jax.jit`` boundaries like ordinary trees.
+    """
+
+    def __init__(self, q: jax.Array, scale: jax.Array,
+                 shape: Tuple[int, ...]):
+        self.q = q              # int8, grouped shape [G, rows/G, cols...]
+        self.scale = scale      # fp32, [G, 1, cols...]
+        self.shape = tuple(shape)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q, scale = children
+        return cls(q, scale, aux)
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
+        w = self.q.astype(jnp.float32) * self.scale
+        return w.reshape(self.shape).astype(dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape)) + self.scale.size * 4
+
+
+DEFAULT_QUANT_PATTERN = r".*(kernel|wte|embedding)$"
+
+
+def _quantize_leaf(w: jax.Array, groups: int) -> QuantizedWeight:
+    shape = w.shape
+    rows = shape[0]
+    g = groups if rows % groups == 0 else 1
+    grouped = jnp.reshape(w.astype(jnp.float32), (g, rows // g) + shape[1:])
+    amax = jnp.max(jnp.abs(grouped), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(grouped / scale), -127, 127).astype(jnp.int8)
+    return QuantizedWeight(q, scale, shape)
+
+
+def quantize_params(params: Any, groups: int = 1,
+                    pattern: str = DEFAULT_QUANT_PATTERN,
+                    min_size: int = 4096) -> Any:
+    """Quantize matching ≥2-D leaves of a param tree to int8; other leaves
+    pass through unchanged. ``groups`` splits the input (row) dimension into
+    independently-scaled groups (the reference's ``quantize_groups``)."""
+    rx = re.compile(pattern)
+
+    def path_str(path):
+        return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+
+    def maybe_quant(path, leaf):
+        if (leaf.ndim >= 2 and leaf.size >= min_size
+                and rx.search(path_str(path))
+                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            return _quantize_leaf(leaf, groups)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(maybe_quant, params)
+
+
+def dequantize_params(params: Any, dtype=jnp.bfloat16) -> Any:
+    """Expand QuantizedWeight leaves back to dense arrays (called inside the
+    jitted forward so XLA fuses dequant into each weight's consumer)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.dequantize(dtype) if isinstance(x, QuantizedWeight) else x,
+        params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+
+
+def quantized_nbytes(params: Any) -> int:
+    """Total HBM bytes of a (possibly partially) quantized tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedWeight)):
+        if isinstance(leaf, QuantizedWeight):
+            total += leaf.nbytes
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -72,7 +72,16 @@ class GPTBlock(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, attn_mask=None, deterministic: bool = True):
+    def __call__(self, x, attn_mask=None, deterministic: bool = True,
+                 kv_cache=None, pos=None):
+        """``kv_cache``: optional ``(k, v)`` arrays of shape
+        [B, max_len, H, Dh] for incremental decoding (the TPU-native analogue
+        of the reference inference kernels' attention cache,
+        csrc/transformer/inference/). With a cache, new k/v are written at
+        ``pos`` and attention runs over the full cache under a
+        position-validity mask (static shapes — jit/scan friendly). Returns
+        ``(x, (k, v))`` in cache mode, plain ``x`` otherwise.
+        """
         cfg = self.cfg
         d = cfg.hidden_size
         dt = cfg.dtype
@@ -86,9 +95,26 @@ class GPTBlock(nn.Module):
         q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
         drop_rng = (None if deterministic or cfg.dropout_rate == 0.0
                     else self.make_rng("dropout"))
-        o = attention(q, k, v, causal=True, mask=attn_mask,
-                      dropout_rate=cfg.dropout_rate, dropout_rng=drop_rng,
-                      deterministic=deterministic, impl=cfg.attention_impl)
+        if kv_cache is not None:
+            ck, cv = kv_cache
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                              (0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                              (0, pos, 0, 0))
+            kv_cache = (ck, cv)
+            # Key j is visible to query i iff j <= pos + i (cached past plus
+            # the causal prefix of this chunk).
+            qpos = pos + jnp.arange(s)
+            kpos = jnp.arange(ck.shape[1])
+            dec_mask = (kpos[None, :] <= qpos[:, None])[None, None]
+            if attn_mask is not None:
+                dec_mask = jnp.logical_and(dec_mask, attn_mask)
+            o = attention(q, ck, cv, causal=False, mask=dec_mask,
+                          deterministic=True, impl="xla")
+        else:
+            o = attention(q, k, v, causal=True, mask=attn_mask,
+                          dropout_rate=cfg.dropout_rate, dropout_rng=drop_rng,
+                          deterministic=deterministic, impl=cfg.attention_impl)
         o = o.reshape(b, s, d)
         o = nn.Dense(d, dtype=dt, name="c_proj")(o)
         o = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(o)
@@ -100,7 +126,8 @@ class GPTBlock(nn.Module):
         h = nn.gelu(h, approximate=True)
         h = nn.Dense(d, dtype=dt, name="mlp_proj")(h)
         h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
-        return x + h
+        x = x + h
+        return (x, kv_cache) if kv_cache is not None else x
 
 
 class GPT(nn.Module):
@@ -114,7 +141,14 @@ class GPT(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, batch, deterministic: bool = False):
+    def __call__(self, batch, deterministic: bool = False,
+                 cache=None, pos=None):
+        """Training/eval: ``__call__(batch)`` → {"loss", "logits"}.
+
+        Incremental decoding (inference engine): pass ``cache`` (per-layer
+        tuple of (k, v) arrays from :func:`init_kv_cache`) and the write
+        offset ``pos`` → {"logits", "cache"}; no loss is computed.
+        """
         cfg = self.cfg
         ids = batch["input_ids"]
         b, s = ids.shape
@@ -122,19 +156,39 @@ class GPT(nn.Module):
                          (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
-        x = wte[ids].astype(cfg.dtype) + wpe[:s][None].astype(cfg.dtype)
+        if pos is None:
+            pe = wpe[:s][None]
+        else:
+            pe = jnp.take(wpe, pos + jnp.arange(s), axis=0)[None]
+        x = wte[ids].astype(cfg.dtype) + pe.astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
 
         attn_mask = None
         if "attention_mask" in batch and batch["attention_mask"] is not None:
             am = batch["attention_mask"]          # [B, S] 1=keep
-            attn_mask = am[:, None, None, :].astype(jnp.bool_)
+            if cache is not None:
+                # Cache mode: the key axis is the cache length, not this
+                # chunk. The user's [B, S] mask covers positions
+                # pos..pos+S; keys already cached (< pos) stay visible.
+                lmax = cache[0][0].shape[1]
+                km = jnp.ones((b, lmax), jnp.bool_)
+                km = jax.lax.dynamic_update_slice(
+                    km, am.astype(jnp.bool_), (0, pos if pos is not None else 0))
+                attn_mask = km[:, None, None, :]
+            else:
+                attn_mask = am[:, None, None, :].astype(jnp.bool_)
 
         block = GPTBlock
         if cfg.remat:
             block = nn.remat(GPTBlock, static_argnums=(3,))
+        new_cache = []
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"h_{i}")(x, attn_mask, deterministic)
+            if cache is not None:
+                x, layer_kv = block(cfg, name=f"h_{i}")(
+                    x, attn_mask, True, cache[i], pos)
+                new_cache.append(layer_kv)
+            else:
+                x = block(cfg, name=f"h_{i}")(x, attn_mask, deterministic)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
                          name="ln_f")(x)
@@ -146,8 +200,22 @@ class GPT(nn.Module):
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               name="lm_head")(x.astype(cfg.dtype)).astype(jnp.float32)
 
+        if cache is not None:
+            return {"logits": logits, "cache": tuple(new_cache)}
         loss = cross_entropy_with_ignore(logits, shift_labels(batch))
         return {"loss": loss, "logits": logits}
+
+
+def init_kv_cache(cfg: GPTConfig, batch_size: int, max_len: int,
+                  dtype=None) -> Tuple:
+    """Per-layer (k, v) cache arrays [B, max_len, H, Dh] for incremental
+    decoding. Static shapes — the decode loop updates in place via
+    ``dynamic_update_slice`` so the whole generate fits in one jitted scan."""
+    dtype = dtype if dtype is not None else cfg.dtype
+    shape = (batch_size, max_len, cfg.num_heads, cfg.head_dim)
+    return tuple(
+        (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        for _ in range(cfg.num_layers))
 
 
 def shift_labels(batch) -> jax.Array:

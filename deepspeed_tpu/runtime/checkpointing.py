@@ -154,6 +154,32 @@ def _version() -> str:
     return __version__
 
 
+def load_module_params(load_dir: str, tag: Optional[str] = None):
+    """Restore only the model param tree from a training checkpoint — the
+    inference engine's ``checkpoint=`` loading path (reference
+    ``InferenceEngine._load_checkpoint``, inference/engine.py:212). No engine
+    or optimizer state is constructed."""
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        elif os.path.isdir(os.path.join(load_dir, STATE_SUBDIR)):
+            tag = ""  # load_dir is itself a tag directory
+        else:
+            raise FileNotFoundError(
+                f"no '{LATEST_FILE}' file in {load_dir} and it is not a "
+                f"tag directory (no '{STATE_SUBDIR}/' inside); pass tag= "
+                f"or point at a checkpoint written by save_checkpoint")
+    path = os.path.abspath(os.path.join(_tag_dir(load_dir, tag) if tag
+                                        else load_dir, STATE_SUBDIR))
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"checkpoint state dir not found: {path}")
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(path)
+    return jax.tree_util.tree_map(jax.numpy.asarray, restored["params"])
+
+
 # ---------------------------------------------------------------------------
 # zero_to_fp32 equivalent (reference utils/zero_to_fp32.py)
 # ---------------------------------------------------------------------------

@@ -120,3 +120,30 @@ def test_config_rejects_external_batch_info():
     cfg["elasticity"]["ignore_non_elastic_batch_info"] = True
     ds = DeepSpeedTPUConfig(cfg, world_size=64)
     assert ds.train_batch_size == 9792
+
+
+def test_candidate_batch_never_exceeds_cap():
+    """Regression: an lcm(micro_batches) larger than max_train_batch_size
+    must not leak through as a candidate (it previously won with scale=1)."""
+    from deepspeed_tpu.elasticity.elasticity import _best_batch
+
+    batch, valid = _best_batch([7, 9, 11], 50, 1, 64, True)
+    assert batch <= 50
+    assert valid
+
+
+def test_per_chip_alias_also_guarded():
+    import pytest
+
+    from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+    from deepspeed_tpu.elasticity import ElasticityConfigError
+
+    cfg = {
+        "train_micro_batch_size_per_chip": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "elasticity": {"enabled": True, "max_train_batch_size": 1024,
+                       "micro_batch_sizes": [2, 4],
+                       "min_gpus": 1, "max_gpus": 64, "version": 0.1},
+    }
+    with pytest.raises(ElasticityConfigError):
+        DeepSpeedTPUConfig(cfg)
