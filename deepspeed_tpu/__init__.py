@@ -18,6 +18,7 @@ from deepspeed_tpu.parallel.topology import (PipeDataParallelTopology,
                                              ProcessTopology)
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
 from deepspeed_tpu.runtime.engine import TPUEngine, TrainState
+from deepspeed_tpu.runtime.zero import zero_init
 from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -113,7 +114,7 @@ def init_inference(model=None, **kwargs):
 
 
 __all__ = [
-    "initialize", "init_inference", "add_config_arguments", "init_distributed",
+    "initialize", "init_inference", "add_config_arguments", "init_distributed", "zero_init",
     "build_mesh", "TPUEngine", "TrainState", "DeepSpeedTPUConfig",
     "DeepSpeedDataLoader", "RepeatingLoader", "ProcessTopology",
     "PipeDataParallelTopology", "PipeModelDataParallelTopology",

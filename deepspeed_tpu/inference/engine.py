@@ -100,6 +100,12 @@ class InferenceEngine:
             injection_policy
         if rules is None:
             rules = self._default_rules()
+        if rules is None and cfg.mp_size > 1:
+            raise ValueError(
+                f"mp_size={cfg.mp_size} requested but "
+                f"{type(model).__name__} has no built-in partition rules — "
+                f"pass partition_rules=/injection_policy= ((regex, dims) "
+                f"pairs, see models/partition.py) or mp_size=1")
         self._param_specs = None
         cast = lambda p: (p.astype(cfg.dtype)
                           if jnp.issubdtype(p.dtype, jnp.floating) else p)
@@ -126,12 +132,12 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     def _default_rules(self):
-        name = type(self.module).__name__
-        if name == "GPT":
-            from deepspeed_tpu.models import gpt_partition_rules
+        from deepspeed_tpu.models import (BertModel, GPT,
+                                          bert_partition_rules,
+                                          gpt_partition_rules)
+        if isinstance(self.module, GPT):
             return gpt_partition_rules()
-        if name == "BertModel":
-            from deepspeed_tpu.models import bert_partition_rules
+        if isinstance(self.module, BertModel):
             return bert_partition_rules()
         return None
 
@@ -195,6 +201,17 @@ class InferenceEngine:
                 f"{type(self.module).__name__} does not")
         ids = jnp.asarray(input_ids, jnp.int32)
         b, t0 = ids.shape
+        total = t0 + int(max_new_tokens)
+        limit = getattr(self.model_cfg, "max_seq_len", None)
+        if self.config.max_tokens is not None:
+            limit = (min(limit, self.config.max_tokens) if limit is not None
+                     else self.config.max_tokens)
+        if limit is not None and total > limit:
+            raise ValueError(
+                f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) = "
+                f"{total} exceeds the usable context of {limit} "
+                f"(model max_seq_len / init_inference max_tokens) — "
+                f"positions past it would silently clamp")
         key = (b, t0, int(max_new_tokens), float(temperature), int(top_k))
         if key not in self._generate_jit:
             self._generate_jit[key] = jax.jit(functools.partial(

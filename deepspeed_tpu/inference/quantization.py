@@ -60,6 +60,11 @@ def _quantize_leaf(w: jax.Array, groups: int) -> QuantizedWeight:
     shape = w.shape
     rows = shape[0]
     g = groups if rows % groups == 0 else 1
+    if g != groups:
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            f"quantize_groups={groups} does not divide leading dim {rows} "
+            f"of a {shape} weight; falling back to one scale group for it")
     grouped = jnp.reshape(w.astype(jnp.float32), (g, rows // g) + shape[1:])
     amax = jnp.max(jnp.abs(grouped), axis=1, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0

@@ -123,8 +123,18 @@ class BertModel(nn.Module):
         layer = BertLayer
         if cfg.remat:
             layer = nn.remat(BertLayer, static_argnums=(3,))
+        # Progressive Layer Drop — BERT is the reference's PLD target
+        # (progressive_layer_drop.py + the PLD gates in its modeling files):
+        # keep prob p_l = 1 - l/L * (1 - theta), theta injected per step by
+        # the engine as batch["pld_theta"].
+        pld_theta = batch.get("pld_theta")
         for i in range(cfg.num_layers):
-            x = layer(cfg, name=f"layer_{i}")(x, attn_mask, deterministic)
+            y = layer(cfg, name=f"layer_{i}")(x, attn_mask, deterministic)
+            if pld_theta is not None and not deterministic:
+                p_keep = 1.0 - (i / cfg.num_layers) * (1.0 - pld_theta)
+                gate = jax.random.bernoulli(self.make_rng("dropout"), p_keep)
+                y = jnp.where(gate, y, x)
+            x = y
         if cfg.pre_layer_norm:
             x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
                              name="ln_f")(x).astype(cfg.dtype)

@@ -181,6 +181,10 @@ class GPT(nn.Module):
         block = GPTBlock
         if cfg.remat:
             block = nn.remat(GPTBlock, static_argnums=(3,))
+        # Progressive Layer Drop (reference progressive_layer_drop.py +
+        # engine hooks): per-step keep prob p_l = 1 - l/L * (1 - theta);
+        # the engine injects batch["pld_theta"] when pld.enabled.
+        pld_theta = batch.get("pld_theta") if isinstance(batch, dict) else None
         new_cache = []
         for i in range(cfg.num_layers):
             if cache is not None:
@@ -188,7 +192,13 @@ class GPT(nn.Module):
                     x, attn_mask, True, cache[i], pos)
                 new_cache.append(layer_kv)
             else:
-                x = block(cfg, name=f"h_{i}")(x, attn_mask, deterministic)
+                y = block(cfg, name=f"h_{i}")(x, attn_mask, deterministic)
+                if pld_theta is not None and not deterministic:
+                    p_keep = 1.0 - (i / cfg.num_layers) * (1.0 - pld_theta)
+                    gate = jax.random.bernoulli(self.make_rng("dropout"),
+                                                p_keep)
+                    y = jnp.where(gate, y, x)
+                x = y
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
                          name="ln_f")(x)

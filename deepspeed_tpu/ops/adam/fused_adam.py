@@ -12,9 +12,8 @@ kernel chunking is needed. The update runs in fp32 on the (possibly
 data-axis-sharded) master params; with ZeRO>=1 every device only updates its
 own shard, matching stage2.py:1554's "local Adam on own partition".
 
-``adam_update`` is the scalar math; ``FusedAdam`` packages init/update over a
-pytree. A Pallas variant lives in ``deepspeed_tpu/ops/adam/pallas_adam.py``
-for the HBM-bound fused param+moment update.
+``FusedAdam`` packages init/update over a pytree; XLA fuses the whole-tree
+elementwise update without a hand-written kernel.
 """
 
 from typing import Any, NamedTuple, Optional
@@ -107,11 +106,12 @@ class FusedAdamW(FusedAdam):
 class HostOffloadAdam(FusedAdam):
     """Host-memory Adam — the DeepSpeedCPUAdam analogue (ZeRO-Offload).
 
-    The optimizer moments live in host RAM; each step streams the (sharded)
-    grads to host, updates there, and streams updated master params back.
-    Used via the engine's offload_optimizer=cpu path; see
-    runtime/zero/offload.py for the transfer plumbing. The update math is
-    identical to FusedAdam — XLA on CPU vectorises it (the AVX analogue).
+    Selecting this optimizer (config ``optimizer.type: "cpu_adam"``) enables
+    the engine's host offload tier even without an ``offload_optimizer``
+    block: fp32 master params + moments live in host RAM
+    (``runtime/zero/offload.py`` owns the placement and the jitted XLA:CPU
+    update — the AVX-kernel analogue), and each step streams sharded grads
+    down / compute-dtype params back. The update math is FusedAdam's.
     """
 
     host_resident = True

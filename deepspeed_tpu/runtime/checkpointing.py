@@ -175,8 +175,18 @@ def load_module_params(load_dir: str, tag: Optional[str] = None):
                                         else load_dir, STATE_SUBDIR))
     if not os.path.isdir(path):
         raise FileNotFoundError(f"checkpoint state dir not found: {path}")
-    ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(path)
+    # Partial restore of just the params subtree: a TrainState checkpoint is
+    # ~4x the param bytes (moments + grad accumulator); inference must not
+    # pay that in host RAM or load time.
+    meta = ocp.StandardCheckpointer().metadata(path)
+    params_meta = meta.item_metadata.tree["params"]
+    template = jax.tree_util.tree_map(
+        lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype), params_meta)
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(
+        path, item={"params": template}, transforms={},
+        restore_args=ocp.checkpoint_utils.construct_restore_args(
+            {"params": template}))
     return jax.tree_util.tree_map(jax.numpy.asarray, restored["params"])
 
 

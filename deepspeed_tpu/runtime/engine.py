@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+from deepspeed_tpu.config.config import ConfigError, DeepSpeedTPUConfig
 from deepspeed_tpu.config import constants as C
 from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, FusedAdamW, HostOffloadAdam
 from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
@@ -171,6 +171,14 @@ class TPUEngine:
         self.lr_scheduler = lr_scheduler if lr_scheduler is not None \
             else build_lr_schedule(config.scheduler_name, config.scheduler_params)
         self._base_lr = getattr(self.optimizer, "lr", 1e-3)
+        # optimizer.type "cpuadam" implies the host tier even without an
+        # explicit offload_optimizer block (reference cpu_adam semantics).
+        # Engine-local: must not mutate the caller's (possibly shared) config.
+        self._offload_cfg = config.zero_config.offload_optimizer
+        if (getattr(self.optimizer, "host_resident", False)
+                and not self._offload_cfg.enabled):
+            from deepspeed_tpu.runtime.zero.config import ZeroOffloadConfig
+            self._offload_cfg = ZeroOffloadConfig(device="cpu")
 
         # --- initial state placement ---------------------------------------
         self.state = self._init_state(params, rng_seed)
@@ -194,6 +202,42 @@ class TPUEngine:
                 ranks=[0])
         self.steps_per_print = config.steps_per_print
         self.wall_clock_breakdown = config.wall_clock_breakdown
+
+        # --- aux subsystems driven by their config blocks -------------------
+        if config.sparse_gradients_enabled:
+            raise ConfigError(
+                "sparse_gradients is not supported on TPU: XLA AD always "
+                "materializes dense gradients and compiles dense "
+                "collectives, so the reference's CSR embedding-gradient "
+                "exchange (csr_tensor.py) has no bandwidth to save here; "
+                "see runtime/sparse_tensor.py for the rationale and the "
+                "CsrTensor utility")
+        self.progressive_layer_drop = None
+        if config.pld.enabled:
+            if getattr(self.optimizer, "needs_local_grads", False):
+                raise ConfigError(
+                    "progressive_layer_drop with a 1-bit optimizer is not "
+                    "supported: the local-grad shard_map step applies one "
+                    "batch spec to every leaf and cannot carry the "
+                    "pld_theta scalar")
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=config.pld.theta, gamma=config.pld.gamma)
+        from deepspeed_tpu.utils.monitor import build_monitor
+        self.monitor = build_monitor(config.tensorboard)
+        self.moq = None
+        if config.quantize_training.get("enabled", False):
+            from deepspeed_tpu.ops.quantizer import MoQConfig, MoQQuantizer
+            self.moq = MoQQuantizer(MoQConfig.from_dict(
+                config.quantize_training))
+        self.flops_profiler = None
+        if config.flops_profiler.enabled:
+            from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+            self.flops_profiler = FlopsProfiler(config.flops_profiler)
+        from deepspeed_tpu.runtime import activation_checkpointing as _ac
+        if not _ac.is_configured():
+            _ac.configure(deepspeed_config=config)
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size,
@@ -234,6 +278,8 @@ class TPUEngine:
     # ------------------------------------------------------------------
     def _init_state(self, params: Any, rng_seed: int) -> TrainState:
         """Place master params / moments / grad-acc with their ZeRO shardings."""
+        if self._offload_cfg.enabled:
+            return self._init_offload_state(params, rng_seed)
         mesh = self.mesh
 
         def shard_like(tree, specs):
@@ -270,6 +316,173 @@ class TPUEngine:
                 loss_scale=jax.device_put(self.loss_scaler.init(), rep),
                 skipped_steps=jax.device_put(jnp.zeros((), jnp.int32), rep),
                 rng=jax.device_put(jax.random.PRNGKey(rng_seed), rep))
+
+    def _init_offload_state(self, params: Any, rng_seed: int) -> TrainState:
+        """ZeRO-Offload layout: fp32 master + moments live on host (or NVMe);
+        the device holds only compute-dtype params. See
+        runtime/zero/offload.py for the tier design."""
+        from deepspeed_tpu.runtime.zero.offload import (OptimizerOffloader,
+                                                        to_host)
+
+        ocfg = self._offload_cfg
+        if self.config.zero_config.stage == 3:
+            raise ValueError(
+                "offload_optimizer with ZeRO stage 3 is not supported; "
+                "use stage <= 2 (the param tier stays on-device via GSPMD)")
+        mesh = self.mesh
+        compute_dtype = (self.precision.dtype if self.precision.mixed
+                         else jnp.float32)
+        self.offloader = OptimizerOffloader(
+            self.optimizer, params, device=ocfg.device,
+            nvme_path=ocfg.nvme_path, buffer_count=int(ocfg.buffer_count),
+            compute_dtype=compute_dtype,
+            aio_threads=int(self.config.aio.thread_count))
+
+        # Device compute params: TP specs if provided, replicated over data.
+        base = self._base_specs if self._base_specs is not None else \
+            jax.tree_util.tree_map(lambda _: PartitionSpec(), params)
+        self._compute_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), base)
+        self._compute_params = jax.jit(
+            lambda t: jax.tree_util.tree_map(
+                lambda a: a.astype(compute_dtype), t),
+            out_shardings=self._compute_shardings)(params)
+
+        cpu_master = self.offloader.master          # None for nvme tier
+        cpu_opt = self.offloader.opt_state
+        placeholder = jnp.zeros((), jnp.float32)
+        rep = NamedSharding(mesh, PartitionSpec())
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            micro_step=jnp.zeros((), jnp.int32),
+            params=cpu_master if cpu_master is not None else placeholder,
+            opt_state=cpu_opt if cpu_opt is not None else placeholder,
+            grad_acc=placeholder,
+            loss_scale=to_host(self.loss_scaler.init()),
+            skipped_steps=jnp.zeros((), jnp.int32),
+            rng=jax.device_put(jax.random.PRNGKey(rng_seed), rep))
+
+    def _build_offload_step_fns(self) -> None:
+        """Step functions for the offloaded optimizer tier: a device-side
+        jitted micro-batch scan producing (sharded) grads + overflow/norm
+        scalars, then the host/NVMe optimizer step, then compute-dtype params
+        placed back onto the mesh. ``train_batch()`` only — per-microbatch
+        forward/backward would bounce host transfers per micro step."""
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        fp16 = cfg.fp16.enabled
+        predivide = cfg.prescale_gradients
+        precision = self.precision
+        loss_fn = self.loss_fn
+        mesh = self.mesh
+
+        grad_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self.grad_specs)
+
+        def scaled_loss_fn(compute_params, batch, rng, scale):
+            out = loss_fn(compute_params, batch, rng)
+            loss, aux = (out if isinstance(out, tuple) else (out, None))
+            loss32 = loss.astype(jnp.float32)
+            scaled = loss32 * scale / gas
+            if predivide:
+                scaled = scaled / self.dp_size * cfg.gradient_predivide_factor
+            return scaled, (loss32, aux)
+
+        def micro_scan(compute_params, rng, batches, scale):
+            def body(carry, batch):
+                acc, rng = carry
+                rng, sub = jax.random.split(rng)
+                grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
+                (_, (loss, _)), grads = grad_fn(compute_params, batch, sub,
+                                                scale)
+                acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                return (acc, rng), loss
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), compute_params)
+            (acc, rng), losses = jax.lax.scan(body, (zeros, rng), batches)
+            acc = jax.lax.with_sharding_constraint(acc, grad_shardings)
+            overflow = (has_inf_or_nan(acc) if fp16
+                        else jnp.zeros((), jnp.bool_))
+            norm = global_norm(acc)
+            return acc, rng, jnp.mean(losses), overflow, norm
+
+        self._offload_micro_scan = jax.jit(micro_scan)
+
+        def cast_tree(tree):
+            dt = (precision.dtype if precision.mixed else jnp.float32)
+            return jax.tree_util.tree_map(lambda a: a.astype(dt), tree)
+
+        self._offload_cast = jax.jit(cast_tree, donate_argnums=(0,))
+
+        def offload_place(tree):
+            placed = jax.device_put(tree, self._compute_shardings)
+            return self._offload_cast(placed)
+
+        self._offload_place = offload_place
+
+        def eval_step(compute_params, batch):
+            out = loss_fn(compute_params, batch, None)
+            loss, aux = (out if isinstance(out, tuple) else (out, None))
+            return loss.astype(jnp.float32), aux
+
+        self._offload_eval = jax.jit(eval_step)
+        self._micro_step = None
+        self._apply_step = None
+        self._train_step = None
+        self._eval_step = None
+
+    def _offload_train_batch(self, batches) -> jax.Array:
+        from deepspeed_tpu.runtime.zero.offload import to_host
+
+        cfg = self.config
+        fp16 = cfg.fp16.enabled
+        state = self.state
+        scale_f = float(state.loss_scale.scale) if fp16 else 1.0
+        if (self.flops_profiler is not None and
+                self.global_steps + 1 == self.flops_profiler.config.profile_step):
+            prof = self.flops_profiler.profile_callable(
+                self._offload_micro_scan, self._compute_params, state.rng,
+                batches, jnp.float32(scale_f), params=self._compute_params,
+                detailed=self.flops_profiler.config.detailed, measure=False)
+            out_file = self.flops_profiler.config.output_file
+            if out_file:
+                with open(out_file, "w") as f:
+                    self.flops_profiler.print_profile(prof, file=f)
+            else:
+                self.flops_profiler.print_profile(prof)
+        acc, rng, loss, overflow_d, norm_d = self._offload_micro_scan(
+            self._compute_params, state.rng, batches, jnp.float32(scale_f))
+        grads_h = to_host(acc)
+        overflow = bool(overflow_d) if fp16 else False
+        # Unscale + clip folded into one per-leaf coefficient (compensating
+        # prescale_gradients' in-loss pre-division, as _make_apply_step does).
+        coef = 1.0 / scale_f
+        if cfg.prescale_gradients:
+            coef = coef * self.dp_size / cfg.gradient_predivide_factor
+        unscaled_norm = float(norm_d) * coef
+        self._offload_last_norm = unscaled_norm
+        if cfg.gradient_clipping > 0.0 and not overflow:
+            if unscaled_norm > cfg.gradient_clipping:
+                coef = coef * cfg.gradient_clipping / (unscaled_norm + 1e-6)
+        lr = float(self._current_lr())
+        compute_h = self.offloader.update(grads_h, lr, coef,
+                                          jnp.bool_(overflow))
+        self._compute_params = self._offload_place(compute_h)
+        new_ls = self.loss_scaler.update(state.loss_scale,
+                                         jnp.bool_(overflow))
+        self.state = state._replace(
+            step=state.step + (0 if overflow else 1),
+            micro_step=state.micro_step + cfg.gradient_accumulation_steps,
+            params=(self.offloader.master if self.offloader.master is not None
+                    else state.params),
+            opt_state=(self.offloader.opt_state
+                       if self.offloader.opt_state is not None
+                       else state.opt_state),
+            loss_scale=new_ls, rng=rng,
+            skipped_steps=state.skipped_steps + int(overflow))
+        return loss
 
     def _opt_state_specs(self, opt_state: Any, params: Any) -> Any:
         """Spec tree for the optimizer state: any sub-tree that mirrors the
@@ -331,6 +544,9 @@ class TPUEngine:
         return apply_step
 
     def _build_step_fns(self) -> None:
+        if self._offload_cfg.enabled:
+            self._build_offload_step_fns()
+            return
         if getattr(self.optimizer, "needs_local_grads", False):
             self._build_local_grad_step_fns()
             return
@@ -528,10 +744,15 @@ class TPUEngine:
         """Compute loss and accumulate grads for one micro-batch."""
         if self._micro_step is None:
             raise RuntimeError(
-                "this optimizer requires the fused train_batch() path "
-                "(1-bit optimizers accumulate local grads inside one step)")
+                "this configuration requires the fused train_batch() path "
+                "(1-bit optimizers accumulate local grads inside one step; "
+                "offloaded optimizers batch the host round-trip per step)")
         if self.wall_clock_breakdown:
             self.timers("forward").start()
+        if self.progressive_layer_drop is not None and isinstance(batch, dict):
+            theta = self.progressive_layer_drop.update_state(self.global_steps)
+            batch = dict(batch)
+            batch["pld_theta"] = np.float32(theta)
         batch = self.put_batch(batch)
         self.state, loss, _ = self._micro_step(self.state, batch)
         self._last_loss = loss
@@ -569,13 +790,77 @@ class TPUEngine:
             log_dist(f"step={self.global_steps} loss={loss:.4f} "
                      f"lr={float(lr):.3e} loss_scale={float(self.state.loss_scale.scale):.1f}",
                      ranks=[0])
+        if self._last_loss is not None:
+            self._post_step_hooks(self._last_loss)
+
+    def _inject_pld(self, batches):
+        if self.progressive_layer_drop is None or not isinstance(batches, dict):
+            return batches
+        theta = self.progressive_layer_drop.update_state(self.global_steps)
+        batches = dict(batches)
+        # leading GAS dim so the micro-batch scan can carry it (one scalar
+        # per micro-step)
+        batches["pld_theta"] = np.full(
+            (self.gradient_accumulation_steps,), theta, np.float32)
+        return batches
+
+    def _post_step_hooks(self, loss):
+        if self.moq is not None:
+            key = jax.random.fold_in(jax.random.PRNGKey(17), self.global_steps)
+            if hasattr(self, "offloader"):
+                self.offloader.master = self.moq.quantize_tree(
+                    self.offloader.master, self.global_steps, key)
+                self.state = self.state._replace(params=self.offloader.master)
+                self._compute_params = self._offload_place(
+                    jax.tree_util.tree_map(np.asarray, self.offloader.master))
+            else:
+                self.state = self.state._replace(params=self.moq.quantize_tree(
+                    self.state.params, self.global_steps, key))
+        if self.monitor is not None:
+            self.monitor.add_scalar("Train/Samples/train_loss", float(loss),
+                                    self.global_steps)
+            self.monitor.add_scalar("Train/Samples/lr",
+                                    float(self._current_lr()),
+                                    self.global_steps)
+            if self.config.fp16.enabled:
+                self.monitor.add_scalar("Train/Samples/loss_scale",
+                                        float(self.state.loss_scale.scale),
+                                        self.global_steps)
 
     def train_batch(self, batches) -> jax.Array:
         """Fused full step: ``batches`` is a pytree whose leaves have leading
         dim gradient_accumulation_steps (one entry per micro-batch)."""
+        if self._train_step is None:  # offloaded optimizer tier
+            self.tput_timer.start()
+            batches = self.put_batch(self._inject_pld(batches),
+                                     leading_gas_dim=True)
+            loss = self._offload_train_batch(batches)
+            self.global_steps += 1
+            self.micro_steps += self.gradient_accumulation_steps
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            self.tput_timer.stop()
+            self._last_loss = loss
+            self._post_step_hooks(loss)
+            return loss
         self.tput_timer.start()
-        batches = self.put_batch(batches, leading_gas_dim=True)
+        batches = self.put_batch(self._inject_pld(batches),
+                                 leading_gas_dim=True)
         lr = self._current_lr()
+        if (self.flops_profiler is not None and
+                self.global_steps + 1 == self.flops_profiler.config.profile_step):
+            # lower+compile only (measure=False): must not execute the
+            # donating step function on the live state.
+            prof = self.flops_profiler.profile_callable(
+                self._train_step, self.state, batches, lr,
+                params=self.state.params,
+                detailed=self.flops_profiler.config.detailed, measure=False)
+            out_file = self.flops_profiler.config.output_file
+            if out_file:
+                with open(out_file, "w") as f:
+                    self.flops_profiler.print_profile(prof, file=f)
+            else:
+                self.flops_profiler.print_profile(prof)
         self.state, loss, overflow, _ = self._train_step(self.state, batches, lr)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
@@ -583,10 +868,14 @@ class TPUEngine:
             self.lr_scheduler.step()
         self.tput_timer.stop()
         self._last_loss = loss
+        self._post_step_hooks(loss)
         return loss
 
     def eval_batch(self, batch):
         batch = self.put_batch(batch)
+        if self._eval_step is None:  # offload tier: params already compute-dtype
+            loss, _ = self._offload_eval(self._compute_params, batch)
+            return loss
         loss, _ = self._eval_step(self.state, batch)
         return loss
 
@@ -596,9 +885,15 @@ class TPUEngine:
     @property
     def module_params(self):
         """Compute-precision view of the parameters."""
+        if hasattr(self, "offloader"):
+            return self._compute_params
         return self.precision.cast_params(self.state.params)
 
     def get_global_grad_norm(self) -> float:
+        if hasattr(self, "offloader"):
+            # grads never persist in state.grad_acc under offload; report the
+            # unscaled norm of the last step's accumulated grads.
+            return float(getattr(self, "_offload_last_norm", 0.0))
         with self.mesh:
             return float(jax.jit(global_norm)(self.state.grad_acc))
 
@@ -621,11 +916,20 @@ class TPUEngine:
     # ------------------------------------------------------------------
     # Checkpointing — delegates to runtime.checkpointing
     # ------------------------------------------------------------------
+    def _offload_nvme(self) -> bool:
+        return (hasattr(self, "offloader")
+                and getattr(self.offloader, "tier", None) == "nvme")
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None,
                         save_latest: bool = True) -> str:
         from deepspeed_tpu.runtime import checkpointing as ckpt
 
+        if self._offload_nvme():
+            raise NotImplementedError(
+                "checkpointing with offload_optimizer.device='nvme' is not "
+                "supported; use device='cpu' (host tier checkpoints "
+                "transparently) or consolidate via offloader.master_tree()")
         return ckpt.save_checkpoint(self, save_dir, tag=tag,
                                     client_state=client_state or {},
                                     save_latest=save_latest)
@@ -635,6 +939,18 @@ class TPUEngine:
                         load_lr_scheduler_states: bool = True):
         from deepspeed_tpu.runtime import checkpointing as ckpt
 
-        return ckpt.load_checkpoint(self, load_dir, tag=tag,
-                                    load_optimizer_states=load_optimizer_states,
-                                    load_lr_scheduler_states=load_lr_scheduler_states)
+        if self._offload_nvme():
+            raise NotImplementedError(
+                "checkpointing with offload_optimizer.device='nvme' is not "
+                "supported; use device='cpu'")
+        out = ckpt.load_checkpoint(self, load_dir, tag=tag,
+                                   load_optimizer_states=load_optimizer_states,
+                                   load_lr_scheduler_states=load_lr_scheduler_states)
+        if hasattr(self, "offloader") and out[0] is not None:
+            # Push restored host state back into the offload tier and
+            # refresh the device compute params from the new master.
+            self.offloader.master = self.state.params
+            self.offloader.opt_state = self.state.opt_state
+            self._compute_params = self._offload_place(
+                jax.tree_util.tree_map(np.asarray, self.state.params))
+        return out

@@ -1,0 +1,43 @@
+"""Progressive Layer Drop.
+
+Reference: ``deepspeed/runtime/progressive_layer_drop.py:5`` (theta schedule)
++ the engine hooks at ``engine.py:1085,1327`` + the PLD gating inside the
+Megatron/BERT modeling files. The schedule is identical:
+
+    theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar
+
+with ``theta_bar`` the configured asymptotic keep probability. Layer *l* of
+*L* then keeps its sublayers with probability ``p_l = 1 - l/L * (1 - theta)``
+(deeper layers drop more), sampled per step per layer.
+
+TPU-native wiring: theta is a *traced scalar input* to the jitted train step
+— the engine injects it into the batch as ``batch["pld_theta"]`` and the
+in-tree model families gate each block with a Bernoulli draw from the
+dropout rng stream, so the drop pattern changes every step without
+recompilation.
+"""
+
+import math
+
+
+class ProgressiveLayerDrop:
+    """Theta schedule (reference progressive_layer_drop.py API parity:
+    ``get_state``, ``get_theta``, ``update_state``)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)     # theta_bar, asymptotic keep prob
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+
+    def get_theta(self, global_step: int = None) -> float:
+        if global_step is None:
+            return self.current_theta
+        return ((1.0 - self.theta) * math.exp(-self.gamma * global_step)
+                + self.theta)
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = self.get_theta(global_step)
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}

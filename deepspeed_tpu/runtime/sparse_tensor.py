@@ -1,0 +1,74 @@
+"""CSR-style sparse tensor (API parity with reference csr_tensor.py).
+
+Reference: ``deepspeed/runtime/csr_tensor.py:11`` — compressed row-sparse
+gradients for huge embedding tables, reduced rank-to-rank by exchanging
+(indices, values) instead of the dense table
+(``runtime/engine.py:1530-1586`` sparse_allreduce).
+
+TPU note (why the *engine* rejects ``sparse_gradients: true``, see
+``TPUEngine.__init__``): torch's sparse embedding autograd emits genuinely
+sparse gradients, so skipping dense allreduce saves real bandwidth there.
+XLA's AD always materializes dense gradients and its collectives are
+compiled over static dense shapes; a CSR re-compression inside the jitted
+step would add a gather/scatter round-trip without removing the dense
+buffer. The utility below is provided for API/tooling parity (checkpoint
+surgery, host-side gradient analysis) with the reference's semantics
+(sparse row dedup on ``to_dense``).
+"""
+
+from typing import Tuple
+
+import numpy as np
+
+
+class CsrTensor:
+    """Row-sparse [N, D] tensor: ``indices`` [nnz] row ids (may repeat —
+    duplicates sum on densify, matching torch sparse semantics),
+    ``values`` [nnz, D]."""
+
+    def __init__(self, indices: np.ndarray, values: np.ndarray,
+                 dense_shape: Tuple[int, int]):
+        self.indices = np.asarray(indices, np.int64)
+        self.values = np.asarray(values)
+        self.dense_shape = tuple(dense_shape)
+        if self.values.shape[0] != self.indices.shape[0]:
+            raise ValueError("indices/values leading dims differ")
+
+    @classmethod
+    def from_dense(cls, dense: np.ndarray) -> "CsrTensor":
+        dense = np.asarray(dense)
+        rows = np.flatnonzero(np.any(dense != 0, axis=tuple(
+            range(1, dense.ndim))))
+        return cls(rows, dense[rows], dense.shape)
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.dense_shape, self.values.dtype)
+        np.add.at(out, self.indices, self.values)
+        return out
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    @property
+    def sparsity(self) -> float:
+        return 1.0 - self.nnz / max(self.dense_shape[0], 1)
+
+    def scale(self, s: float) -> "CsrTensor":
+        return CsrTensor(self.indices, self.values * s, self.dense_shape)
+
+    def add(self, other: "CsrTensor") -> "CsrTensor":
+        if other.dense_shape != self.dense_shape:
+            raise ValueError("shape mismatch")
+        return CsrTensor(
+            np.concatenate([self.indices, other.indices]),
+            np.concatenate([self.values, other.values]),
+            self.dense_shape)
+
+    def coalesce(self) -> "CsrTensor":
+        """Merge duplicate rows (sum), sort by row id."""
+        uniq, inv = np.unique(self.indices, return_inverse=True)
+        vals = np.zeros((len(uniq),) + self.values.shape[1:],
+                        self.values.dtype)
+        np.add.at(vals, inv, self.values)
+        return CsrTensor(uniq, vals, self.dense_shape)

@@ -1,0 +1,7 @@
+"""Tensor swapping tier (host RAM <-> NVMe) — reference
+``deepspeed/runtime/swap_tensor/``."""
+
+from deepspeed_tpu.runtime.swap_tensor.aio import (AsyncTensorSwapper,
+                                                   PipelinedLeafSwapper)
+
+__all__ = ["AsyncTensorSwapper", "PipelinedLeafSwapper"]

@@ -1,0 +1,138 @@
+"""Asynchronous tensor disk I/O — the aio tier.
+
+TPU-native analogue of the reference's libaio stack (``csrc/aio/``,
+``deepspeed/runtime/swap_tensor/aio_utils`` and
+``AsyncTensorSwapper``/``AsyncIOBuilder``): a thread-pool of O_DIRECT-free
+buffered writers/readers moving numpy buffers between host RAM and NVMe
+files, with futures standing in for aio completion queues. Python threads
+release the GIL inside ``np.tofile``/``np.fromfile``, so reads/writes overlap
+host compute exactly as the reference overlaps aio submits with CUDA work
+(``pipelined_optimizer_swapper.py:60``).
+
+Swap files are one flat binary per tensor under ``base_dir`` — the layout of
+the reference's per-parameter swap paths (``partitioned_param_swapper.py``).
+"""
+
+import os
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class AsyncTensorSwapper:
+    """Write/read named numpy tensors to per-name swap files, asynchronously.
+
+    ``swap_out(name, arr)`` and ``swap_in(name)`` return futures;
+    ``num_inflight`` and byte counters mirror the reference swapper's
+    accounting (swap_out_tensors/AsyncTensorSwapper, optimizer_utils.py).
+    """
+
+    def __init__(self, base_dir: str, num_threads: int = 2):
+        self.base_dir = base_dir
+        self.num_threads = num_threads
+        os.makedirs(base_dir, exist_ok=True)
+        self._pool = ThreadPoolExecutor(max_workers=num_threads,
+                                        thread_name_prefix="dstpu-aio")
+        self._meta: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {}
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self.bytes_written = 0
+        self.bytes_read = 0
+
+    def _path(self, name: str) -> str:
+        safe = name.replace("/", "__")
+        return os.path.join(self.base_dir, f"{safe}.swp")
+
+    def _done(self, _fut):
+        with self._lock:
+            self._inflight -= 1
+
+    @property
+    def num_inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def swap_out(self, name: str, arr: np.ndarray) -> Future:
+        """Queue a write of ``arr`` to ``name``'s swap file."""
+        arr = np.ascontiguousarray(arr)
+        self._meta[name] = (arr.shape, arr.dtype)
+
+        def write():
+            arr.tofile(self._path(name))
+            with self._lock:
+                self.bytes_written += arr.nbytes
+            return name
+
+        with self._lock:
+            self._inflight += 1
+        fut = self._pool.submit(write)
+        fut.add_done_callback(self._done)
+        return fut
+
+    def swap_in(self, name: str) -> Future:
+        """Queue a read; the future resolves to the numpy array."""
+        if name not in self._meta:
+            raise KeyError(f"no swapped tensor named '{name}'")
+        shape, dtype = self._meta[name]
+
+        def read():
+            out = np.fromfile(self._path(name), dtype=dtype).reshape(shape)
+            with self._lock:
+                self.bytes_read += out.nbytes
+            return out
+
+        with self._lock:
+            self._inflight += 1
+        fut = self._pool.submit(read)
+        fut.add_done_callback(self._done)
+        return fut
+
+    def contains(self, name: str) -> bool:
+        return name in self._meta
+
+    def synchronize(self) -> None:
+        """Barrier: wait for every queued request (aio wait analogue)."""
+        self._pool.shutdown(wait=True)
+        self._pool = ThreadPoolExecutor(max_workers=self.num_threads,
+                                        thread_name_prefix="dstpu-aio")
+
+    def close(self, remove_files: bool = False) -> None:
+        self._pool.shutdown(wait=True)
+        if remove_files:
+            for name in list(self._meta):
+                try:
+                    os.remove(self._path(name))
+                except OSError:
+                    pass
+            self._meta.clear()
+
+
+class PipelinedLeafSwapper:
+    """Double-buffered per-leaf streaming over a sequence of named tensors —
+    the ``PipelinedOptimizerSwapper`` analogue: while leaf *i* is being
+    computed on, leaf *i+1*'s state is already being read from disk and leaf
+    *i-1*'s result is being written back."""
+
+    def __init__(self, swapper: AsyncTensorSwapper):
+        self.swapper = swapper
+
+    def stream(self, names: Sequence[str], compute_fn):
+        """For each name (whose state was previously swapped out), read its
+        tensors, call ``compute_fn(name, arr) -> new_arr``, write the result
+        back. Reads are prefetched one leaf ahead."""
+        if not names:
+            return
+        pending_read = self.swapper.swap_in(names[0])
+        write_fut: Optional[Future] = None
+        for i, name in enumerate(names):
+            arr = pending_read.result()
+            if i + 1 < len(names):
+                pending_read = self.swapper.swap_in(names[i + 1])
+            new_arr = compute_fn(name, arr)
+            if write_fut is not None:
+                write_fut.result()
+            write_fut = self.swapper.swap_out(name, np.asarray(new_arr))
+        if write_fut is not None:
+            write_fut.result()

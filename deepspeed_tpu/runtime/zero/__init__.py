@@ -1,0 +1,10 @@
+"""ZeRO subsystem: sharding policies, offload tiers, shard-at-construction."""
+
+from deepspeed_tpu.runtime.zero.config import ZeroConfig, ZeroOffloadConfig
+from deepspeed_tpu.runtime.zero.init import zero_init
+from deepspeed_tpu.runtime.zero.partition import (
+    ZeroPartitioner, ZeroPolicy, estimate_zero_model_states_mem_needs)
+
+__all__ = ["ZeroConfig", "ZeroOffloadConfig", "ZeroPartitioner",
+           "ZeroPolicy", "zero_init",
+           "estimate_zero_model_states_mem_needs"]

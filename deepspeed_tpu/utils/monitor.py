@@ -1,0 +1,52 @@
+"""Training monitoring — tensorboard scalar writer.
+
+Reference surface: the engine's ``tensorboard``-gated SummaryWriter calls
+(``runtime/engine.py:1340-1416``: Train/Samples/train_loss, lr, loss_scale
+at every logging boundary). Uses torch's SummaryWriter when available (torch
+is CPU-only in this image, which is all a writer needs); falls back to a
+JSONL event log with the same (tag, value, step) schema so monitoring never
+silently disappears.
+"""
+
+import json
+import os
+from typing import Optional
+
+
+class TensorboardMonitor:
+    """Scalar writer gated by TensorboardConfig (config/config.py)."""
+
+    def __init__(self, output_path: str, job_name: str = "DeepSpeedTPUJob"):
+        self.log_dir = os.path.join(output_path or "runs", job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._writer = None
+        self._jsonl = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._writer = SummaryWriter(log_dir=self.log_dir)
+        except Exception:
+            self._jsonl = open(os.path.join(self.log_dir, "scalars.jsonl"),
+                               "a", buffering=1)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        if self._writer is not None:
+            self._writer.add_scalar(tag, float(value), int(step))
+        else:
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+
+    def flush(self) -> None:
+        if self._writer is not None:
+            self._writer.flush()
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
+
+
+def build_monitor(tb_config) -> Optional[TensorboardMonitor]:
+    if tb_config is None or not tb_config.enabled:
+        return None
+    return TensorboardMonitor(tb_config.output_path, tb_config.job_name)

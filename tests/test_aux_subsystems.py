@@ -1,0 +1,342 @@
+"""Aux-subsystem tests: FlopsProfiler, tensorboard monitor, PLD,
+eigenvalue, MoQ quantization, CSR tensor, activation checkpointing.
+
+These are the config blocks VERDICT r1 flagged as parse-and-ignore; each
+test drives the block through observable behavior (or the loud rejection).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config.config import ConfigError
+
+
+def mlp_loss_fn(params, batch, rng):
+    h = jnp.tanh(batch["x"] @ params["w1"])
+    pred = h @ params["w2"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def mlp_params(key=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(key))
+    return {"w1": jax.random.normal(k1, (8, 16)) * 0.3,
+            "w2": jax.random.normal(k2, (16, 4)) * 0.3}
+
+
+def mlp_batch(rng, gas=1, bs=8):
+    return {"x": rng.standard_normal((gas, bs, 8)).astype(np.float32),
+            "y": rng.standard_normal((gas, bs, 4)).astype(np.float32)}
+
+
+def build(config_extra, rng_seed=0):
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": 0}}
+    cfg.update(config_extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn, params=mlp_params(), config=cfg,
+        rng_seed=rng_seed)
+    return engine
+
+
+class TestFlopsProfiler:
+    def test_profile_callable_counts_matmul(self):
+        from deepspeed_tpu.profiling import FlopsProfiler
+
+        def f(a, b):
+            return a @ b
+
+        a = jnp.ones((64, 128), jnp.float32)
+        b = jnp.ones((128, 32), jnp.float32)
+        prof = FlopsProfiler()
+        r = prof.profile_callable(f, a, b, detailed=True)
+        want = 2 * 64 * 128 * 32
+        assert r["flops"] >= want * 0.5  # XLA counts >= the matmul itself
+        assert r["breakdown"].get("matmul", 0) == want
+        assert r["latency_s"] > 0
+        text = prof.print_profile(r, file=open(os.devnull, "w"))
+        assert "TFLOP/s" in text
+
+    def test_engine_profile_step_writes_file(self, rng, tmp_path):
+        out = tmp_path / "flops.txt"
+        engine = build({"flops_profiler": {
+            "enabled": True, "profile_step": 2, "output_file": str(out)}})
+        for _ in range(3):
+            engine.train_batch(mlp_batch(rng))
+        assert out.exists()
+        content = out.read_text()
+        assert "flops/step" in content and "Flops Profiler" in content
+
+    def test_profiler_fires_under_offload(self, rng, tmp_path):
+        out = tmp_path / "flops_off.txt"
+        engine = build({"flops_profiler": {
+            "enabled": True, "profile_step": 1, "output_file": str(out)},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}}})
+        engine.train_batch(mlp_batch(rng))
+        assert out.exists() and "flops/step" in out.read_text()
+
+
+class TestMonitor:
+    def test_scalars_written(self, rng, tmp_path):
+        engine = build({"tensorboard": {"enabled": True,
+                                        "output_path": str(tmp_path),
+                                        "job_name": "job1"}})
+        for _ in range(3):
+            engine.train_batch(mlp_batch(rng))
+        logdir = tmp_path / "job1"
+        files = os.listdir(logdir)
+        assert files, "no event files written"
+        if "scalars.jsonl" in files:  # fallback writer
+            lines = [json.loads(l) for l in open(logdir / "scalars.jsonl")]
+            tags = {l["tag"] for l in lines}
+            assert "Train/Samples/train_loss" in tags
+
+    def test_disabled_no_monitor(self, rng):
+        engine = build({})
+        assert engine.monitor is None
+
+
+class TestPLD:
+    def test_theta_schedule(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import \
+            ProgressiveLayerDrop
+
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.get_theta(0) == pytest.approx(1.0)
+        assert pld.get_theta(10 ** 6) == pytest.approx(0.5)
+        a, b = pld.get_theta(10), pld.get_theta(100)
+        assert 0.5 < b < a < 1.0
+        pld.update_state(50)
+        assert pld.get_state()["pld_theta"] == pytest.approx(
+            pld.get_theta(50))
+
+    def test_engine_injects_theta_and_model_consumes(self, rng):
+        """GPT-tiny with PLD: training works, and the drop actually changes
+        the computed loss vs no-PLD at equal seeds (gates fire)."""
+        from deepspeed_tpu.models import make_gpt
+
+        model, cfg = make_gpt("tiny", dropout_rate=0.0, num_layers=4)
+        ids = rng.integers(0, cfg.vocab_size, (2, 8, 16)).astype(np.int32)
+        params = model.init({"params": jax.random.PRNGKey(0),
+                             "dropout": jax.random.PRNGKey(1)},
+                            {"input_ids": ids[0]})["params"]
+
+        def eng(pld_on):
+            extra = {"progressive_layer_drop":
+                     {"enabled": True, "theta": 0.1, "gamma": 0.0}} \
+                if pld_on else {}
+            e, _, _, _ = deepspeed_tpu.initialize(
+                model=model, params=params,
+                config={"train_micro_batch_size_per_gpu": 1,
+                        "gradient_accumulation_steps": 2,
+                        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                        "zero_optimization": {"stage": 0}, **extra})
+            return e
+
+        e_pld, e_plain = eng(True), eng(False)
+        assert e_pld.progressive_layer_drop is not None
+        batch = {"input_ids": ids}
+        l_pld = float(e_pld.train_batch(batch))
+        l_plain = float(e_plain.train_batch(batch))
+        assert np.isfinite(l_pld) and np.isfinite(l_plain)
+        # theta=0.1 drops most deep layers; losses must differ measurably
+        assert abs(l_pld - l_plain) > 1e-6
+
+    def test_pld_with_onebit_rejected(self):
+        with pytest.raises(ConfigError, match="1-bit"):
+            build({"optimizer": {"type": "OneBitAdam",
+                                 "params": {"lr": 1e-3}},
+                   "fp16": {"enabled": True},
+                   "progressive_layer_drop": {"enabled": True}})
+
+    def test_pld_injected_on_forward_path(self, rng):
+        """The reference-parity forward/backward/step loop must also see
+        pld_theta (review regression: was train_batch-only)."""
+        from deepspeed_tpu.models import make_gpt
+
+        model, cfg = make_gpt("tiny", dropout_rate=0.0, num_layers=4)
+        ids = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        params = model.init({"params": jax.random.PRNGKey(0),
+                             "dropout": jax.random.PRNGKey(1)},
+                            {"input_ids": ids})["params"]
+
+        def eng(pld_on, seed):
+            extra = {"progressive_layer_drop":
+                     {"enabled": True, "theta": 0.05, "gamma": 0.0}}                 if pld_on else {}
+            e, _, _, _ = deepspeed_tpu.initialize(
+                model=model, params=params, rng_seed=seed,
+                config={"train_micro_batch_size_per_gpu": 1,
+                        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                        "zero_optimization": {"stage": 0}, **extra})
+            return e
+
+        l_pld = float(eng(True, 0).forward({"input_ids": ids}))
+        l_plain = float(eng(False, 0).forward({"input_ids": ids}))
+        assert abs(l_pld - l_plain) > 1e-6
+
+    def test_model_ignores_theta_when_deterministic(self, rng):
+        from deepspeed_tpu.models import make_gpt
+
+        model, cfg = make_gpt("tiny", dropout_rate=0.0, num_layers=2)
+        ids = rng.integers(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+        params = model.init({"params": jax.random.PRNGKey(0),
+                             "dropout": jax.random.PRNGKey(1)},
+                            {"input_ids": ids})["params"]
+        a = model.apply({"params": params}, {"input_ids": ids},
+                        deterministic=True)
+        b = model.apply({"params": params},
+                        {"input_ids": ids,
+                         "pld_theta": jnp.float32(0.1)},
+                        deterministic=True)
+        np.testing.assert_array_equal(np.asarray(a["logits"]),
+                                      np.asarray(b["logits"]))
+
+
+class TestEigenvalue:
+    def test_quadratic_eigenvalue_exact(self):
+        """loss = 0.5 x^T A x has Hessian A; power iteration must find
+        lambda_max(A)."""
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        evs = np.array([5.0, 2.0, 0.5], np.float32)
+        A = np.diag(evs)
+
+        def loss_fn(params, batch, rng):
+            x = params["x"]
+            return 0.5 * x @ jnp.asarray(A) @ x
+
+        e = Eigenvalue(max_iter=200, tol=1e-4)
+        out = e.compute_eigenvalue(loss_fn, {"x": jnp.ones((3,))},
+                                   batch=None)
+        assert out["x"] == pytest.approx(5.0, rel=1e-2)
+
+    def test_per_layer_keys(self):
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        def loss_fn(params, batch, rng):
+            return (jnp.sum(params["a"] ** 2) * 3.0
+                    + jnp.sum(params["b"] ** 2) * 1.0)
+
+        out = Eigenvalue(max_iter=100).compute_eigenvalue(
+            loss_fn, {"a": jnp.ones((4,)), "b": jnp.ones((4,))}, None)
+        assert set(out) == {"a", "b"}
+        assert out["a"] == pytest.approx(6.0, rel=1e-2)   # H = 2*3 I
+        assert out["b"] == pytest.approx(2.0, rel=1e-2)
+
+
+class TestMoQ:
+    def test_bits_schedule(self):
+        from deepspeed_tpu.ops.quantizer import MoQConfig, MoQQuantizer
+
+        q = MoQQuantizer(MoQConfig(start_bits=16, target_bits=8,
+                                   quantize_period=10, schedule_offset=5))
+        assert q.current_bits(0) == 16
+        assert q.current_bits(5 + 9) == 16
+        assert q.current_bits(5 + 10) == 15
+        assert q.current_bits(5 + 10 + 20) == 14
+        assert q.current_bits(10 ** 9) == 8   # floors at target
+
+    def test_sim_quantize_grid(self):
+        from deepspeed_tpu.ops.quantizer import sim_quantize
+
+        w = jnp.asarray(np.random.default_rng(0).standard_normal((16, 8)),
+                        jnp.float32)
+        q8 = sim_quantize(w, 8, 4, True, False, jax.random.PRNGKey(0))
+        q2 = sim_quantize(w, 2, 4, True, False, jax.random.PRNGKey(0))
+        err8 = float(jnp.abs(w - q8).max())
+        err2 = float(jnp.abs(w - q2).max())
+        assert err8 < err2                      # more bits, less error
+        assert err8 <= float(jnp.abs(w).max()) / 127 + 1e-6
+        # asymmetric grid also reconstructs
+        qa = sim_quantize(w, 8, 1, False, False, jax.random.PRNGKey(0))
+        assert float(jnp.abs(w - qa).max()) < 0.05
+
+    def test_engine_applies_moq(self, rng):
+        engine = build({"quantize_training": {
+            "enabled": True,
+            "quantize_bits": {"start_bits": 4, "target_bits": 4},
+            "quantize_schedule": {"quantize_period": 1,
+                                  "schedule_offset": 0},
+            "quantize_groups": 2}})
+        assert engine.moq is not None
+        engine.train_batch(mlp_batch(rng))
+        w = np.asarray(engine.state.params["w1"], np.float64)
+        # weights now sit on a 4-bit per-group grid: few distinct values
+        per_group = w.reshape(2, -1)
+        for g in range(2):
+            assert len(np.unique(np.round(per_group[g], 6))) <= 16
+
+    def test_unknown_keys_rejected(self):
+        from deepspeed_tpu.ops.quantizer import MoQConfig
+
+        with pytest.raises(ValueError, match="unknown quantize_training"):
+            MoQConfig.from_dict({"enabled": True, "tyop": 1})
+
+
+class TestSparseGradients:
+    def test_engine_rejects_loudly(self):
+        with pytest.raises(ConfigError, match="sparse_gradients"):
+            build({"sparse_gradients": True})
+
+    def test_csr_tensor_roundtrip(self):
+        from deepspeed_tpu.runtime.sparse_tensor import CsrTensor
+
+        dense = np.zeros((10, 4), np.float32)
+        dense[2] = 1.0
+        dense[7] = 2.0
+        t = CsrTensor.from_dense(dense)
+        assert t.nnz == 2 and t.sparsity == pytest.approx(0.8)
+        np.testing.assert_array_equal(t.to_dense(), dense)
+        s = t.add(t.scale(2.0)).coalesce()
+        np.testing.assert_array_equal(s.to_dense(), dense * 3.0)
+        assert s.nnz == 2
+
+
+class TestActivationCheckpointing:
+    def test_configure_and_policy(self):
+        from deepspeed_tpu.runtime import activation_checkpointing as ac
+
+        ac.reset()
+        assert not ac.is_configured()
+        ac.configure(partition_activations=True)
+        assert ac.is_configured()
+        assert ac.remat_policy() is jax.checkpoint_policies.nothing_saveable
+        ac.reset()
+        ac.configure()
+        assert (ac.remat_policy()
+                is jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        ac.reset()
+
+    def test_checkpoint_wrapper_grad_parity(self):
+        from deepspeed_tpu.runtime import activation_checkpointing as ac
+
+        ac.reset()
+        ac.configure(partition_activations=True)
+
+        def f(w, x):
+            return jnp.sum(jnp.tanh(x @ w) ** 2)
+
+        w = jnp.asarray(np.random.default_rng(0).standard_normal((8, 8)),
+                        jnp.float32)
+        x = jnp.ones((4, 8), jnp.float32)
+        g_plain = jax.grad(f)(w, x)
+        g_ckpt = jax.grad(lambda w, x: ac.checkpoint(f, w, x))(w, x)
+        np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_ckpt),
+                                   rtol=1e-6)
+        ac.reset()
+
+    def test_engine_configures_from_config_block(self, rng):
+        from deepspeed_tpu.runtime import activation_checkpointing as ac
+
+        ac.reset()
+        build({"activation_checkpointing": {"partition_activations": True}})
+        assert ac.is_configured()
+        assert ac.get_config().partition_activations
+        ac.reset()

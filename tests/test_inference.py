@@ -184,3 +184,31 @@ class TestInitInferenceAPI:
         inf = deepspeed_tpu.init_inference(model, checkpoint=str(tmp_path))
         out = inf.generate(ids, max_new_tokens=2)
         assert np.asarray(out).shape == (2, ids.shape[1] + 2)
+
+
+class TestReviewRegressions:
+    def test_generate_past_context_raises(self, gpt_setup):
+        model, cfg, params, ids = gpt_setup
+        eng = deepspeed_tpu.init_inference(model, params=params)
+        with pytest.raises(ValueError, match="exceeds the usable context"):
+            eng.generate(ids, max_new_tokens=cfg.max_seq_len)
+
+    def test_max_tokens_enforced(self, gpt_setup):
+        model, cfg, params, ids = gpt_setup
+        eng = deepspeed_tpu.init_inference(model, params=params,
+                                           max_tokens=12)
+        with pytest.raises(ValueError, match="exceeds the usable context"):
+            eng.generate(ids, max_new_tokens=8)  # 8 prompt + 8 > 12
+
+    def test_mp_without_rules_raises(self, eight_devices):
+        import flax.linen as nn
+
+        class Plain(nn.Module):
+            @nn.compact
+            def __call__(self, batch, deterministic=True):
+                return {"logits": nn.Dense(4)(batch["x"])}
+
+        with pytest.raises(ValueError, match="partition rules"):
+            deepspeed_tpu.init_inference(
+                Plain(), mp_size=2,
+                example_batch={"x": np.zeros((2, 8), np.float32)})

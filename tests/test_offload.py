@@ -1,0 +1,249 @@
+"""ZeRO-Offload tier tests.
+
+Evidence the VERDICT demanded: moments actually live on host (device
+placement assertions), the offloaded step is numerically the same step as
+the on-device path (loss-trajectory parity), and the NVMe tier round-trips
+through the async swapper. Reference surface: ops/adam/cpu_adam.py,
+runtime/swap_tensor/.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.swap_tensor import (AsyncTensorSwapper,
+                                               PipelinedLeafSwapper)
+
+
+def make_loss_fn():
+    def loss_fn(params, batch, rng):
+        x, y = batch["x"], batch["y"]
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        pred = h @ params["w2"] + params["b2"]
+        return jnp.mean((pred - y) ** 2)
+    return loss_fn
+
+
+def make_params(key=0):
+    k = jax.random.PRNGKey(key)
+    k1, k2 = jax.random.split(k)
+    return {
+        "w1": jax.random.normal(k1, (16, 32)) * 0.1,
+        "b1": jnp.zeros((32,)),
+        "w2": jax.random.normal(k2, (32, 4)) * 0.1,
+        "b2": jnp.zeros((4,)),
+    }
+
+
+def make_batches(rng, gas, bs, steps):
+    out = []
+    for _ in range(steps):
+        x = rng.standard_normal((gas, bs, 16)).astype(np.float32)
+        y = rng.standard_normal((gas, bs, 4)).astype(np.float32)
+        out.append({"x": x, "y": y})
+    return out
+
+
+def build_engine(offload_device=None, nvme_path=None, zero_stage=2,
+                 optimizer_type="Adam"):
+    zero = {"stage": zero_stage}
+    if offload_device:
+        od = {"device": offload_device}
+        if nvme_path:
+            od["nvme_path"] = str(nvme_path)
+        zero["offload_optimizer"] = od
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=make_loss_fn(), params=make_params(),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "gradient_clipping": 1.0,
+            "optimizer": {"type": optimizer_type, "params": {"lr": 1e-2}},
+            "zero_optimization": zero,
+        })
+    return engine
+
+
+class TestCpuOffload:
+    def test_moments_live_on_host(self, eight_devices):
+        engine = build_engine("cpu")
+        m_leaf = jax.tree_util.tree_leaves(engine.offloader.opt_state.exp_avg)[0]
+        assert all(d.platform == "cpu" for d in m_leaf.devices())
+        master_leaf = jax.tree_util.tree_leaves(engine.offloader.master)[0]
+        assert all(d.platform == "cpu" for d in master_leaf.devices())
+        # only ONE host device holds them (committed, not mesh-sharded)
+        assert len(m_leaf.devices()) == 1
+
+    def test_loss_parity_with_ondevice(self, eight_devices, rng):
+        """10 steps offloaded == 10 steps on-device, same data/seed."""
+        batches = make_batches(rng, gas=2, bs=16, steps=10)
+        e_off = build_engine("cpu")
+        e_dev = build_engine(None)
+        losses_off = [float(e_off.train_batch(b)) for b in batches]
+        losses_dev = [float(e_dev.train_batch(b)) for b in batches]
+        np.testing.assert_allclose(losses_off, losses_dev, rtol=2e-4,
+                                   atol=2e-5)
+        # parameters end up in the same place
+        p_off = jax.tree_util.tree_map(np.asarray, e_off.offloader.master)
+        p_dev = jax.tree_util.tree_map(np.asarray, e_dev.state.params)
+        for a, b in zip(jax.tree_util.tree_leaves(p_off),
+                        jax.tree_util.tree_leaves(p_dev)):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+    def test_loss_decreases(self, eight_devices, rng):
+        engine = build_engine("cpu")
+        batch = make_batches(rng, 2, 16, 1)[0]
+        first = float(engine.train_batch(batch))
+        for _ in range(15):
+            last = float(engine.train_batch(batch))
+        assert last < first
+
+    def test_cpu_adam_type_implies_offload(self, eight_devices):
+        engine = build_engine(None, optimizer_type="CPUAdam")
+        assert hasattr(engine, "offloader")
+        assert engine.offloader.tier == "cpu"
+
+    def test_forward_path_raises(self, eight_devices, rng):
+        engine = build_engine("cpu")
+        with pytest.raises(RuntimeError, match="train_batch"):
+            engine.forward({"x": np.zeros((4, 16), np.float32),
+                            "y": np.zeros((4, 4), np.float32)})
+
+    def test_checkpoint_roundtrip(self, eight_devices, rng, tmp_path):
+        engine = build_engine("cpu")
+        batches = make_batches(rng, 2, 16, 3)
+        for b in batches:
+            engine.train_batch(b)
+        engine.save_checkpoint(str(tmp_path))
+        fresh = build_engine("cpu")
+        fresh.load_checkpoint(str(tmp_path))
+        a = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, engine.offloader.master))
+        b = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, fresh.offloader.master))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        # training continues from the restored tier
+        l1 = float(engine.train_batch(batches[0]))
+        l2 = float(fresh.train_batch(batches[0]))
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    def test_stage3_rejected(self, eight_devices):
+        with pytest.raises(ValueError, match="stage 3"):
+            build_engine("cpu", zero_stage=3)
+
+
+class TestNvmeOffload:
+    def test_loss_parity_with_ondevice(self, eight_devices, rng, tmp_path):
+        batches = make_batches(rng, 2, 16, 6)
+        e_nvme = build_engine("nvme", nvme_path=tmp_path / "swap")
+        e_dev = build_engine(None)
+        l_n = [float(e_nvme.train_batch(b)) for b in batches]
+        l_d = [float(e_dev.train_batch(b)) for b in batches]
+        np.testing.assert_allclose(l_n, l_d, rtol=2e-4, atol=2e-5)
+        # swap files exist and carry real traffic
+        assert e_nvme.offloader.swapper.bytes_written > 0
+        assert e_nvme.offloader.swapper.bytes_read > 0
+        e_nvme.offloader.close()
+
+    def test_master_tree_readback(self, eight_devices, rng, tmp_path):
+        e = build_engine("nvme", nvme_path=tmp_path / "swap")
+        batch = make_batches(rng, 2, 16, 1)[0]
+        e.train_batch(batch)
+        tree = e.offloader.master_tree()
+        assert set(tree) == {"w1", "b1", "w2", "b2"}
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree_util.tree_leaves(tree))
+        e.offloader.close()
+
+    def test_nvme_requires_path(self, eight_devices):
+        with pytest.raises(ValueError, match="nvme_path"):
+            build_engine("nvme")
+
+    def test_checkpoint_rejected(self, eight_devices, rng, tmp_path):
+        e = build_engine("nvme", nvme_path=tmp_path / "swap")
+        with pytest.raises(NotImplementedError):
+            e.save_checkpoint(str(tmp_path / "ck"))
+        e.offloader.close()
+
+
+class TestSwapper:
+    def test_roundtrip(self, tmp_path):
+        sw = AsyncTensorSwapper(str(tmp_path))
+        a = np.random.default_rng(0).standard_normal((64, 64)).astype(np.float32)
+        sw.swap_out("layer/w", a).result()
+        b = sw.swap_in("layer/w").result().copy()
+        np.testing.assert_array_equal(a, b)
+        assert sw.bytes_written == a.nbytes
+        sw.close(remove_files=True)
+
+    def test_unknown_name_raises(self, tmp_path):
+        sw = AsyncTensorSwapper(str(tmp_path))
+        with pytest.raises(KeyError):
+            sw.swap_in("nope")
+        sw.close()
+
+    def test_pipelined_stream_updates_all(self, tmp_path):
+        sw = AsyncTensorSwapper(str(tmp_path))
+        names = [f"t{i}" for i in range(5)]
+        for i, n in enumerate(names):
+            sw.swap_out(n, np.full((8,), float(i), np.float32)).result()
+        pipe = PipelinedLeafSwapper(sw)
+        pipe.stream(names, lambda name, arr: arr + 1.0)
+        for i, n in enumerate(names):
+            got = sw.swap_in(n).result()
+            np.testing.assert_array_equal(got, np.full((8,), i + 1.0,
+                                                       np.float32))
+        sw.close(remove_files=True)
+
+    def test_fp16_loss_scaling_with_offload(self, eight_devices, rng):
+        """Dynamic loss scaling drives the host tier's skip path."""
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            loss_fn=make_loss_fn(), params=make_params(),
+            config={
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "fp16": {"enabled": True, "initial_scale_power": 4},
+                "zero_optimization": {"stage": 2,
+                                      "offload_optimizer": {"device": "cpu"}},
+            })
+        batches = make_batches(rng, 2, 16, 5)
+        losses = [float(engine.train_batch(b)) for b in batches]
+        assert all(np.isfinite(l) for l in losses)
+        assert int(engine.state.step) >= 1
+
+
+class TestReviewRegressions:
+    def test_nvme_rejects_non_adam_state(self, eight_devices, tmp_path):
+        with pytest.raises(ValueError, match="nvme offload"):
+            build_engine("nvme", nvme_path=tmp_path / "s",
+                         optimizer_type="SGD")
+
+    def test_grad_norm_reported_under_offload(self, eight_devices, rng):
+        engine = build_engine("cpu")
+        engine.train_batch(make_batches(rng, 2, 16, 1)[0])
+        assert engine.get_global_grad_norm() > 0.0
+
+    def test_shared_config_not_mutated(self, eight_devices):
+        from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+
+        cfg = DeepSpeedTPUConfig({
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "CPUAdam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2},
+        })
+        e1 = deepspeed_tpu.TPUEngine(loss_fn=make_loss_fn(),
+                                     params=make_params(), config=cfg)
+        assert hasattr(e1, "offloader")
+        assert not cfg.zero_config.offload_optimizer.enabled
+        # a second engine with an explicit non-host optimizer from the SAME
+        # config object must not inherit the offload tier
+        from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+        e2 = deepspeed_tpu.TPUEngine(loss_fn=make_loss_fn(),
+                                     params=make_params(), config=cfg,
+                                     optimizer=FusedAdam(lr=1e-2))
+        assert not hasattr(e2, "offloader")
