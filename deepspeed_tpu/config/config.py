@@ -274,6 +274,7 @@ class DeepSpeedTPUConfig:
         # --- subsystem blocks ------------------------------------------------------
         self.zero_config = ZeroConfig.from_dict(d.get(C.ZERO_OPTIMIZATION))
         self.zero_enabled = self.zero_config.enabled
+        self.activation_checkpointing_provided = C.ACTIVATION_CHECKPOINTING in d
         self.activation_checkpointing = ActivationCheckpointingConfig.from_dict(
             d.get(C.ACTIVATION_CHECKPOINTING))
         self.flops_profiler = FlopsProfilerConfig.from_dict(d.get(C.FLOPS_PROFILER))

@@ -52,6 +52,10 @@ def collect_report() -> dict:
         "sequence_parallelism (ring/ulysses)": True,
         "onebit_optimizers": True,
     }
+    from deepspeed_tpu.ops.registry import list_ops
+
+    report["ops"] = {name: spec.available()
+                     for name, spec in sorted(list_ops().items())}
     return report
 
 
@@ -72,6 +76,10 @@ def main():
     print("feature availability")
     for feat, ok in report["features"].items():
         print(f"  {GREEN_OK if ok else RED_NO} {feat}")
+    print("-" * 60)
+    print("op registry (op_builder analogue)")
+    for name, ok in report["ops"].items():
+        print(f"  {GREEN_OK if ok else RED_NO} {name}")
     print("-" * 60)
 
 

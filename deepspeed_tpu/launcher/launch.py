@@ -52,9 +52,19 @@ def build_env(world_info: str, node_rank: int, master_addr: str,
     return env
 
 
+def mpi_rank() -> int:
+    """node_rank from the MPI environment (mpirun backends pass -1)."""
+    for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "PMIX_RANK"):
+        if var in os.environ:
+            return int(os.environ[var])
+    raise RuntimeError("--node_rank=-1 requires an MPI environment "
+                       "(OMPI_COMM_WORLD_RANK / PMI_RANK not set)")
+
+
 def main(args=None):
     args = parse_args(args)
-    env = build_env(args.world_info, args.node_rank, args.master_addr,
+    node_rank = args.node_rank if args.node_rank >= 0 else mpi_rank()
+    env = build_env(args.world_info, node_rank, args.master_addr,
                     args.master_port)
     cmd = [sys.executable, args.user_script] + list(args.user_args)
     logger.info("node %s exec: %s", args.node_rank, " ".join(cmd))

@@ -43,7 +43,7 @@ def parse_args(args=None):
                         default=DEFAULT_MASTER_PORT)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "pdsh", "local"],
+                        choices=["ssh", "pdsh", "local", "openmpi", "mpich"],
                         help="Multi-node backend")
     parser.add_argument("--force_multi", action="store_true",
                         help="Treat as multi-node even for one host")
@@ -150,6 +150,35 @@ def build_host_command(host_idx: int, world: "OrderedDict[str, List[int]]",
     return cmd
 
 
+def build_mpi_command(active: "OrderedDict[str, List[int]]", args,
+                      env_exports: Dict[str, str]) -> List[str]:
+    """One ``mpirun`` launching launch.py on every host — the reference's
+    OpenMPIRunner/MVAPICHRunner (launcher/multinode_runner.py:98,141). Each
+    rank reads its node_rank from the MPI environment
+    (OMPI_COMM_WORLD_RANK / PMI_RANK, see launch.py)."""
+    hosts = list(active.keys())
+    world_blob = encode_world_info(active)
+    master = args.master_addr or hosts[0]
+    per_rank = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+                f"--world_info={world_blob}",
+                "--node_rank=-1",  # from MPI env
+                f"--master_addr={master}",
+                f"--master_port={args.master_port}",
+                args.user_script] + list(args.user_args)
+    if args.launcher == "openmpi":
+        cmd = ["mpirun", "-np", str(len(hosts)),
+               "--host", ",".join(f"{h}:1" for h in hosts),
+               "--map-by", "ppr:1:node"]
+        for k, v in env_exports.items():
+            cmd += ["-x", f"{k}={v}"]
+    else:  # mpich
+        cmd = ["mpirun", "-np", str(len(hosts)),
+               "-hosts", ",".join(hosts), "-ppn", "1"]
+        for k, v in env_exports.items():
+            cmd += ["-genv", k, v]
+    return cmd + per_rank
+
+
 def propagated_env() -> Dict[str, str]:
     """Environment forwarded to workers (reference forwards NCCL*/PYTHON*
     /etc; here: JAX/XLA/TPU/PYTHON plus .deepspeed_env extras,
@@ -188,6 +217,12 @@ def main(args=None):
     if not multi_node:
         cmd = build_host_command(0, active, args, env)
         logger.info("single-node launch: %s", " ".join(map(shlex.quote, cmd)))
+        result = subprocess.run(cmd, env={**os.environ, **env})
+        sys.exit(result.returncode)
+
+    if args.launcher in ("openmpi", "mpich"):
+        cmd = build_mpi_command(active, args, env)
+        logger.info("mpi launch: %s", " ".join(map(shlex.quote, cmd)))
         result = subprocess.run(cmd, env={**os.environ, **env})
         sys.exit(result.returncode)
 

@@ -1,0 +1,63 @@
+"""Loader for the native aio extension (``csrc/aio/aio.cpp``).
+
+Compiles the C++ module once into a per-user cache directory (the
+op_builder JIT-build model of the reference: ``op_builder/builder.py``
+``jit_load``) and imports it. Falls back to None when no toolchain is
+present — callers keep a pure-numpy path.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import Optional
+
+_CACHE: dict = {}
+
+
+def _src_path() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "csrc", "aio", "aio.cpp")
+
+
+def _build_dir() -> str:
+    d = os.environ.get("DSTPU_BUILD_DIR",
+                       os.path.join(os.path.expanduser("~"), ".cache",
+                                    "deepspeed_tpu", "build"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_aio(verbose: bool = False) -> Optional[object]:
+    """Import the compiled ``_dstpu_aio`` module, building it on first use.
+    Returns None (and remembers it) when building is impossible."""
+    if "aio" in _CACHE:
+        return _CACHE["aio"]
+    so_path = os.path.join(
+        _build_dir(),
+        f"_dstpu_aio.{sysconfig.get_config_var('SOABI')}.so")
+    src = _src_path()
+    try:
+        if (not os.path.exists(so_path)
+                or os.path.getmtime(so_path) < os.path.getmtime(src)):
+            include = sysconfig.get_paths()["include"]
+            # Build to a per-pid temp and rename atomically: N launcher
+            # workers may race on a fresh cache, and dlopen of a
+            # half-written .so poisons the process (the reference
+            # op_builder holds a build lock for the same reason).
+            tmp = f"{so_path}.{os.getpid()}.tmp"
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                   f"-I{include}", src, "-o", tmp]
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+            os.replace(tmp, so_path)
+        spec = importlib.util.spec_from_file_location("_dstpu_aio", so_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _CACHE["aio"] = mod
+    except Exception as e:  # no g++ / headers — numpy fallback
+        if verbose:
+            print(f"native aio unavailable: {e}", file=sys.stderr)
+        _CACHE["aio"] = None
+    return _CACHE["aio"]

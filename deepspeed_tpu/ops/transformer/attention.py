@@ -92,6 +92,20 @@ def _as_kv_mask(mask, batch, sk):
     return None
 
 
+# Auto-mode crossover, measured on a real v5e (8-layer BERT-large-shaped
+# stacks, fwd+bwd, with the flash kernel's tuned 512x1024 blocks):
+#   seq 128:  XLA  97 vs pallas 86 TFLOP/s  -> XLA
+#   seq 512:  XLA  79 vs pallas 87          -> pallas
+#   seq 1024: XLA  64 vs pallas 96          -> pallas
+#   seq 2048: XLA  50 vs pallas 85          -> pallas
+#   seq 4096: XLA  37 vs pallas 78          -> pallas
+# Short sequences stay on XLA's fused materialized attention (tiny score
+# tensors, better fusion with the surrounding matmuls); from 512 keys up
+# the O(S) streaming kernel wins on both time and memory. Overridable with
+# impl="pallas"/"xla".
+PALLAS_MIN_SEQ_K = 512
+
+
 def _pallas_ok(q, k, causal, bias, mask, dropout_rate, deterministic):
     if bias is not None:
         return False
@@ -100,8 +114,10 @@ def _pallas_ok(q, k, causal, bias, mask, dropout_rate, deterministic):
     if dropout_rate > 0.0 and not deterministic:
         return False
     sq, sk = q.shape[1], k.shape[1]
-    return (sq % 128 == 0 and sk % 128 == 0 and q.shape[-1] in
-            (64, 128, 256))
+    if not (sq % 128 == 0 and sk % 128 == 0 and q.shape[-1] in
+            (64, 128, 256)):
+        return False
+    return sk >= PALLAS_MIN_SEQ_K
 
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,

@@ -25,8 +25,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Measured on v5e at seq 4096 (fwd+bwd, d=64): 128x128 blocks run at
+# ~1 TF/s (grid/stream overhead dominates) while 512x1024 reaches ~31 TF/s
+# — large blocks keep the MXU fed and amortize the per-program K/V stream.
+# VMEM check: q 512x128 fp32 + k/v 1024x128 + score block 512x1024 fp32
+# ~ 3.5 MB, comfortably inside 16 MB. Both are clamped to the actual
+# sequence lengths for short inputs.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 LANES = 128   # TPU lane width: per-row scalars (lse/delta) are broadcast
               # across the lane dim so their blocks satisfy (8,128) tiling
 NEG_INF = -1e30
@@ -374,8 +380,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+
+    def fit(block, seq):
+        # Largest 128-multiple <= requested that divides seq (the kernels
+        # require whole blocks); non-128-multiple seqs keep the clamp and
+        # hit the explicit divisibility error below.
+        block = min(block, seq)
+        if seq % 128 == 0:
+            while seq % block:
+                block -= 128
+        return block
+
+    block_q = fit(block_q, sq)
+    block_k = fit(block_k, sk)
     if sq % block_q or sk % block_k:
         raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks "
                          f"({block_q},{block_k})")

@@ -8,9 +8,12 @@ step, and the jaxpr gives the pre-fusion per-primitive breakdown. This is
 both cheaper (no per-op Python hooks in the hot path) and more truthful
 (it counts what actually runs after fusion/remat).
 
-``profile_callable`` profiles any jittable ``fn(*args)``; the engine calls
-``profile_engine_step`` at ``flops_profiler.profile_step`` when the config
-block enables it (reference engine hook parity).
+``profile_callable`` profiles any jittable ``fn(*args)``; the engine's
+``_maybe_profile`` hook calls it (measure=False) at
+``flops_profiler.profile_step`` when the config block enables it
+(reference engine hook parity). CAUTION: with measure=True a donating fn
+consumes its args — the first (cold) call's timing is reported and the
+inputs are gone afterwards.
 """
 
 import sys
@@ -111,12 +114,22 @@ class FlopsProfiler:
             except Exception:  # jaxpr walking is best-effort diagnostics
                 result["breakdown"] = {}
         if measure:
-            out = compiled(*args)
-            jax.block_until_ready(out)
+            # Warm-up, then a timed call — but a donating fn deletes its
+            # inputs on the first call, so fall back to timing that first
+            # (cold) call rather than crashing or re-running on corpses.
             t0 = time.perf_counter()
             out = compiled(*args)
             jax.block_until_ready(out)
-            dt = time.perf_counter() - t0
+            cold = time.perf_counter() - t0
+            deleted = any(isinstance(a, jax.Array) and a.is_deleted()
+                          for a in jax.tree_util.tree_leaves(args))
+            if deleted:
+                dt = cold
+            else:
+                t0 = time.perf_counter()
+                out = compiled(*args)
+                jax.block_until_ready(out)
+                dt = time.perf_counter() - t0
             result["latency_s"] = dt
             result["achieved_tflops"] = result["flops"] / dt / 1e12
         self.last = result

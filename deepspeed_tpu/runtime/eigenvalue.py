@@ -86,8 +86,10 @@ class Eigenvalue:
 
                 g = jax.grad(sub_loss)
 
-                def sub_hvp(v, name=name, g=g, sub=sub):
-                    return jax.jvp(g, (sub,), (v,))[1]
+                # jit once per subtree; the up-to-max_iter iterations then
+                # reuse the compiled double-backward (no re-tracing).
+                sub_hvp = jax.jit(
+                    lambda v, g=g, sub=sub: jax.jvp(g, (sub,), (v,))[1])
 
                 key = jax.random.fold_in(rng, i)
                 v = jax.tree_util.tree_map(

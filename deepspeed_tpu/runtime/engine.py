@@ -228,6 +228,12 @@ class TPUEngine:
         self.monitor = build_monitor(config.tensorboard)
         self.moq = None
         if config.quantize_training.get("enabled", False):
+            if self._offload_cfg.enabled and self._offload_cfg.device == "nvme":
+                raise ConfigError(
+                    "quantize_training with offload_optimizer.device='nvme' "
+                    "is not supported: the master params live on disk and "
+                    "the post-step sim-quant would need a full read-modify-"
+                    "write sweep; use device='cpu'")
             from deepspeed_tpu.ops.quantizer import MoQConfig, MoQQuantizer
             self.moq = MoQQuantizer(MoQConfig.from_dict(
                 config.quantize_training))
@@ -235,8 +241,11 @@ class TPUEngine:
         if config.flops_profiler.enabled:
             from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
             self.flops_profiler = FlopsProfiler(config.flops_profiler)
-        from deepspeed_tpu.runtime import activation_checkpointing as _ac
-        if not _ac.is_configured():
+        # An explicit activation_checkpointing block always (re)configures
+        # the module-level policy; absent block leaves it untouched so a
+        # later engine's explicit block is never shadowed.
+        if config.activation_checkpointing_provided:
+            from deepspeed_tpu.runtime import activation_checkpointing as _ac
             _ac.configure(deepspeed_config=config)
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -440,18 +449,9 @@ class TPUEngine:
         fp16 = cfg.fp16.enabled
         state = self.state
         scale_f = float(state.loss_scale.scale) if fp16 else 1.0
-        if (self.flops_profiler is not None and
-                self.global_steps + 1 == self.flops_profiler.config.profile_step):
-            prof = self.flops_profiler.profile_callable(
-                self._offload_micro_scan, self._compute_params, state.rng,
-                batches, jnp.float32(scale_f), params=self._compute_params,
-                detailed=self.flops_profiler.config.detailed, measure=False)
-            out_file = self.flops_profiler.config.output_file
-            if out_file:
-                with open(out_file, "w") as f:
-                    self.flops_profiler.print_profile(prof, file=f)
-            else:
-                self.flops_profiler.print_profile(prof)
+        self._maybe_profile(self._offload_micro_scan, self._compute_params,
+                            state.rng, batches, jnp.float32(scale_f),
+                            params=self._compute_params)
         acc, rng, loss, overflow_d, norm_d = self._offload_micro_scan(
             self._compute_params, state.rng, batches, jnp.float32(scale_f))
         grads_h = to_host(acc)
@@ -793,6 +793,22 @@ class TPUEngine:
         if self._last_loss is not None:
             self._post_step_hooks(self._last_loss)
 
+    def _maybe_profile(self, fn, *args, params=None):
+        """Emit the flops report at profile_step. lower+compile only
+        (measure=False): must not execute a donating step on live state."""
+        if (self.flops_profiler is None or self.global_steps + 1 !=
+                self.flops_profiler.config.profile_step):
+            return
+        prof = self.flops_profiler.profile_callable(
+            fn, *args, params=params,
+            detailed=self.flops_profiler.config.detailed, measure=False)
+        out_file = self.flops_profiler.config.output_file
+        if out_file:
+            with open(out_file, "w") as f:
+                self.flops_profiler.print_profile(prof, file=f)
+        else:
+            self.flops_profiler.print_profile(prof)
+
     def _inject_pld(self, batches):
         if self.progressive_layer_drop is None or not isinstance(batches, dict):
             return batches
@@ -847,20 +863,8 @@ class TPUEngine:
         batches = self.put_batch(self._inject_pld(batches),
                                  leading_gas_dim=True)
         lr = self._current_lr()
-        if (self.flops_profiler is not None and
-                self.global_steps + 1 == self.flops_profiler.config.profile_step):
-            # lower+compile only (measure=False): must not execute the
-            # donating step function on the live state.
-            prof = self.flops_profiler.profile_callable(
-                self._train_step, self.state, batches, lr,
-                params=self.state.params,
-                detailed=self.flops_profiler.config.detailed, measure=False)
-            out_file = self.flops_profiler.config.output_file
-            if out_file:
-                with open(out_file, "w") as f:
-                    self.flops_profiler.print_profile(prof, file=f)
-            else:
-                self.flops_profiler.print_profile(prof)
+        self._maybe_profile(self._train_step, self.state, batches, lr,
+                            params=self.state.params)
         self.state, loss, overflow, _ = self._train_step(self.state, batches, lr)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps

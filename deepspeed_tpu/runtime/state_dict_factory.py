@@ -1,0 +1,138 @@
+"""Model-parallel checkpoint resharding (merge/split across MP degrees).
+
+Reference: ``deepspeed/runtime/state_dict_factory.py:199`` — the Megatron
+loader that retargets a checkpoint saved at MP degree N onto degree M by
+concatenating or slicing each tensor along its parallel dimension, with the
+QKV projection handled specially (each rank's shard interleaves its q, k, v
+slices, so a naive concat scrambles heads; the reference splits into thirds
+per rank before merging — ``megatron_sd_loader`` qkv handling).
+
+TPU-native framing: rules are the same (regex → action) declarative shape
+as ``models/partition.py``; actions are ``("cat", axis)``, ``("qkv", axis)``
+or ``None`` (replicated — shards must agree, take the first). The in-tree
+GPT family's rules are provided; any Megatron-layout external checkpoint
+can supply its own.
+"""
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+def gpt_mp_rules() -> Tuple[Tuple[str, Optional[Tuple[str, int]]], ...]:
+    """MP merge/split rules for the in-tree GPT family — mirrors
+    ``gpt_partition_rules`` (column-parallel qkv/fc-in on the output dim,
+    row-parallel proj/fc-out on the input dim, vocab-parallel embedding)."""
+    return (
+        (r".*c_attn/kernel$", ("qkv", 1)),
+        (r".*c_attn/bias$", ("qkv", 0)),
+        (r".*c_fc/kernel$", ("cat", 1)),
+        (r".*c_fc/bias$", ("cat", 0)),
+        (r".*(c_proj|mlp_proj)/kernel$", ("cat", 0)),
+        (r".*(c_proj|mlp_proj)/bias$", None),
+        (r".*wte$", ("cat", 0)),
+        (r".*lm_head/kernel$", ("cat", 1)),
+        (r".*", None),
+    )
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
+def _action_for(name: str, rules) -> Optional[Tuple[str, int]]:
+    for pat, action in rules:
+        if re.search(pat, name):
+            return action
+    return None
+
+
+def _merge_qkv(shards: Sequence[np.ndarray], axis: int) -> np.ndarray:
+    """Each shard holds [.., 3*d_r] with its q|k|v thirds interleaved; a
+    correct merge concatenates all q thirds, then k, then v."""
+    thirds = [np.split(s, 3, axis=axis) for s in shards]
+    return np.concatenate(
+        [np.concatenate([t[i] for t in thirds], axis=axis)
+         for i in range(3)], axis=axis)
+
+
+def _split_qkv(full: np.ndarray, mp: int, axis: int) -> List[np.ndarray]:
+    q, k, v = np.split(full, 3, axis=axis)
+    qs = np.split(q, mp, axis=axis)
+    ks = np.split(k, mp, axis=axis)
+    vs = np.split(v, mp, axis=axis)
+    return [np.concatenate([qs[r], ks[r], vs[r]], axis=axis)
+            for r in range(mp)]
+
+
+def merge_mp_checkpoints(shards: Sequence[Any],
+                         rules=None) -> Any:
+    """Merge per-MP-rank param trees (list ordered by rank) into the full
+    tree (reference ``merge_state_dict``, state_dict_factory.py:199)."""
+    rules = rules if rules is not None else gpt_mp_rules()
+    if len(shards) == 1:
+        return shards[0]
+
+    flat0, treedef = jax.tree_util.tree_flatten_with_path(shards[0])
+    flat_rest = [jax.tree_util.tree_flatten_with_path(s)[0]
+                 for s in shards[1:]]
+
+    out = []
+    for i, (path, leaf0) in enumerate(flat0):
+        name = _path_str(path)
+        pieces = [np.asarray(leaf0)] + [np.asarray(f[i][1])
+                                        for f in flat_rest]
+        action = _action_for(name, rules)
+        if action is None:
+            for p in pieces[1:]:
+                if p.shape != pieces[0].shape:
+                    raise ValueError(
+                        f"replicated leaf '{name}' differs across MP shards")
+            out.append(pieces[0])
+        elif action[0] == "cat":
+            out.append(np.concatenate(pieces, axis=action[1]))
+        elif action[0] == "qkv":
+            out.append(_merge_qkv(pieces, action[1]))
+        else:
+            raise ValueError(f"unknown MP action {action} for '{name}'")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def split_mp_checkpoint(tree: Any, mp: int, rules=None) -> List[Any]:
+    """Split a full tree into ``mp`` per-rank trees (reference
+    ``split_state_dict``, the 1→N direction of MP retargeting)."""
+    rules = rules if rules is not None else gpt_mp_rules()
+    if mp == 1:
+        return [tree]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    per_rank: List[List[np.ndarray]] = [[] for _ in range(mp)]
+    for path, leaf in flat:
+        name = _path_str(path)
+        leaf = np.asarray(leaf)
+        action = _action_for(name, rules)
+        if action is None:
+            for r in range(mp):
+                per_rank[r].append(leaf)
+            continue
+        kind, axis = action
+        if leaf.shape[axis] % (3 * mp if kind == "qkv" else mp):
+            raise ValueError(
+                f"'{name}' dim {axis} ({leaf.shape[axis]}) not divisible "
+                f"for mp={mp}")
+        pieces = (_split_qkv(leaf, mp, axis) if kind == "qkv"
+                  else np.split(leaf, mp, axis=axis))
+        for r in range(mp):
+            per_rank[r].append(pieces[r])
+    return [jax.tree_util.tree_unflatten(treedef, leaves)
+            for leaves in per_rank]
+
+
+def reshard_mp_checkpoint(shards: Sequence[Any], target_mp: int,
+                          rules=None) -> List[Any]:
+    """N→M retargeting: merge then re-split (reference ``check_ckpt_list``
+    + merge/split dispatch)."""
+    full = merge_mp_checkpoints(shards, rules)
+    return split_mp_checkpoint(full, target_mp, rules)

@@ -67,8 +67,14 @@ class OptimizerOffloader:
         self.tier = device
         self.compute_dtype = compute_dtype
         cpu = host_device()
-        self.master = to_host(jax.tree_util.tree_map(
-            lambda p: p.astype(jnp.float32), master_params))
+        # The jitted cast materializes NEW host buffers: a bare device_put
+        # of already-host fp32 arrays would alias the caller's params, and
+        # the donating host step would then delete them out from under the
+        # user (same hazard as TPUEngine._init_state's shard_like).
+        host = to_host(master_params)
+        self.master = jax.jit(
+            lambda t: jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32), t))(host)
 
         if self.tier == "cpu":
             self.opt_state = jax.device_put(optimizer.init(self.master), cpu)

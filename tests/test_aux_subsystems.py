@@ -340,3 +340,45 @@ class TestActivationCheckpointing:
         assert ac.is_configured()
         assert ac.get_config().partition_activations
         ac.reset()
+
+
+class TestReviewRegressions2:
+    def test_moq_with_nvme_offload_rejected(self, tmp_path):
+        with pytest.raises(ConfigError, match="nvme"):
+            build({"quantize_training": {"enabled": True},
+                   "zero_optimization": {"stage": 2,
+                                         "offload_optimizer":
+                                         {"device": "nvme",
+                                          "nvme_path": str(tmp_path)}}})
+
+    def test_later_engine_ac_block_wins(self, rng):
+        from deepspeed_tpu.runtime import activation_checkpointing as ac
+
+        ac.reset()
+        build({})  # no block: must not configure globally
+        assert not ac.is_configured()
+        build({"activation_checkpointing": {"cpu_checkpointing": True}})
+        assert ac.is_configured() and ac.get_config().cpu_checkpointing
+        build({"activation_checkpointing": {"partition_activations": True}})
+        assert ac.get_config().partition_activations  # later block wins
+        ac.reset()
+
+    def test_profiler_measure_survives_donating_fn(self):
+        from deepspeed_tpu.profiling import FlopsProfiler
+
+        donating = jax.jit(lambda a: a * 2.0, donate_argnums=(0,))
+        x = jnp.ones((128, 128))
+        r = FlopsProfiler().profile_callable(donating, x, measure=True,
+                                             detailed=False)
+        assert r["latency_s"] > 0  # timed the cold call, no crash
+
+    def test_swapper_read_after_pending_write(self, tmp_path):
+        from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=4)
+        for i in range(20):
+            a = np.full((4096,), float(i), np.float32)
+            sw.swap_out("t", a)          # do NOT wait
+            got = sw.swap_in("t").result()
+            np.testing.assert_array_equal(got, a)
+        sw.close(remove_files=True)

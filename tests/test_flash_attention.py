@@ -170,3 +170,19 @@ class TestFlashBackward:
             np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                        atol=5e-4, rtol=5e-4,
                                        err_msg=f"d{name} mismatch")
+
+
+def test_auto_dispatch_shapes_always_run():
+    """Regression: every shape _pallas_ok admits must execute — the tuned
+    512/1024 block defaults must self-fit to 128-multiple sequences that
+    are not multiples of the block (e.g. 768)."""
+    import numpy as np
+
+    from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    for seq in (128, 256, 640, 768, 1152):
+        q = jnp.asarray(rng.standard_normal((1, seq, 2, 64)), jnp.float32)
+        out = flash_attention(q, q, q, causal=True, interpret=True)
+        assert out.shape == q.shape
+        assert np.isfinite(np.asarray(out)).all()

@@ -1,0 +1,160 @@
+"""Integration tier — loss-parity trajectories, elastic resume, launcher.
+
+The reference's model-level tests train Megatron GPT-2 for hundreds of
+steps and grep the loss curve (tests/model/Megatron_GPT2/run_func_test.py:
+20-36); its checkpoint tests resume across world resizes. The TPU-native
+equivalents run on the virtual 8-device mesh:
+
+- ZeRO-n must reproduce plain-DP loss trajectories step for step (the whole
+  point of "sharding is a placement policy, not different math");
+- checkpoint → resize dp 8→4 → resume must continue the same trajectory
+  (orbax resharding ≡ elastic_checkpoint);
+- runner → launch.py → jax.distributed must rendezvous two real processes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import make_gpt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def gpt_data():
+    model, cfg = make_gpt("tiny", dropout_rate=0.0, num_layers=2,
+                          max_seq_len=32)
+    rng = np.random.default_rng(7)
+    steps = 200
+    data = rng.integers(0, cfg.vocab_size, (steps, 1, 8, 32)).astype(np.int32)
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)},
+                        {"input_ids": data[0, 0]})["params"]
+    return model, cfg, params, data
+
+
+def run_trajectory(model, params, data, stage, steps, mesh=None):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, params=params, mesh=mesh,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": stage,
+                    "stage3_param_persistence_threshold": 1024}})
+    losses = []
+    for t in range(steps):
+        losses.append(float(engine.train_batch({"input_ids": data[t]})))
+    return engine, np.asarray(losses)
+
+
+class TestLossParity:
+    def test_zero_stages_match_dp_over_200_steps(self, gpt_data,
+                                                 eight_devices):
+        """ZeRO 1/2/3 trajectories == stage-0 DP trajectory, 200 steps."""
+        model, cfg, params, data = gpt_data
+        _, base = run_trajectory(model, params, data, stage=0, steps=200)
+        assert base[-20:].mean() < base[:20].mean(), "tiny GPT must learn"
+        for stage in (1, 2, 3):
+            _, traj = run_trajectory(model, params, data, stage=stage,
+                                     steps=200)
+            np.testing.assert_allclose(
+                traj, base, rtol=2e-3, atol=2e-3,
+                err_msg=f"ZeRO-{stage} diverged from DP")
+
+    def test_offload_matches_dp_over_50_steps(self, gpt_data, eight_devices):
+        model, cfg, params, data = gpt_data
+        _, base = run_trajectory(model, params, data, stage=2, steps=50)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, params=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {
+                        "stage": 2,
+                        "offload_optimizer": {"device": "cpu"}}})
+        off = [float(engine.train_batch({"input_ids": data[t]}))
+               for t in range(50)]
+        np.testing.assert_allclose(off, base, rtol=2e-3, atol=2e-3)
+
+
+class TestElasticResume:
+    def test_resume_across_dp_resize(self, gpt_data, eight_devices,
+                                     tmp_path):
+        """Train 5 steps on dp=8, checkpoint, restore on dp=4, continue —
+        the dp=4 continuation must match an unbroken dp=8 run (same global
+        batch; orbax reshards the state, ≡ reference elastic_checkpoint)."""
+        from deepspeed_tpu.parallel.mesh import build_mesh
+
+        model, cfg, params, data = gpt_data
+        e8, first = run_trajectory(model, params, data, stage=2, steps=5)
+        e8.save_checkpoint(str(tmp_path))
+
+        mesh4 = build_mesh(data=4, devices=jax.devices()[:4])
+        engine4, _, _, _ = deepspeed_tpu.initialize(
+            model=model, params=params, mesh=mesh4,
+            config={"train_micro_batch_size_per_gpu": 2,  # same global batch
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2}})
+        path, _ = engine4.load_checkpoint(str(tmp_path))
+        assert path is not None
+        assert engine4.global_steps == 5
+        cont4 = [float(engine4.train_batch({"input_ids": data[5 + t]}))
+                 for t in range(5)]
+
+        _, unbroken = run_trajectory(model, params, data, stage=2, steps=10)
+        np.testing.assert_allclose(cont4, unbroken[5:], rtol=2e-3,
+                                   atol=2e-3)
+
+
+class TestLauncherE2E:
+    def test_two_process_rendezvous(self, tmp_path):
+        """launch.py → user script → init_distributed: two real processes
+        rendezvous over the coordination service (the runner's ssh/pdsh
+        layer is exercised up to command construction elsewhere)."""
+        from deepspeed_tpu.launcher.runner import encode_world_info
+
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import sys, os, json\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from deepspeed_tpu.parallel.mesh import init_distributed\n"
+            "init_distributed()\n"
+            "out = {'rank': jax.process_index(),\n"
+            "       'nprocs': jax.process_count(),\n"
+            "       'ndev': len(jax.devices())}\n"
+            "print('RESULT ' + json.dumps(out))\n")
+        world = encode_world_info({"host-a": [0], "host-b": [0]})
+        procs = []
+        for rank in (0, 1):
+            env = {k: v for k, v in os.environ.items()
+                   if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+                 "--world_info", world, "--node_rank", str(rank),
+                 "--master_addr", "127.0.0.1", "--master_port", "29871",
+                 str(script)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env={**env, "PYTHONPATH": REPO}, text=True))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            assert p.returncode == 0, f"worker failed:\n{out}"
+            outs.append(out)
+        results = []
+        for out in outs:
+            lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+            assert lines, f"no RESULT line in:\n{out}"
+            results.append(json.loads(lines[0][len("RESULT "):]))
+        assert {r["rank"] for r in results} == {0, 1}
+        assert all(r["nprocs"] == 2 for r in results)
+        assert all(r["ndev"] == 2 for r in results)

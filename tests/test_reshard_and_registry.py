@@ -1,0 +1,154 @@
+"""MP checkpoint resharding, MPI launcher commands, op registry."""
+
+import sys
+from collections import OrderedDict
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.state_dict_factory import (gpt_mp_rules,
+                                                      merge_mp_checkpoints,
+                                                      reshard_mp_checkpoint,
+                                                      split_mp_checkpoint)
+
+
+def full_tree(d=8, heads_dim=None):
+    rng = np.random.default_rng(0)
+    return {
+        "h_0": {
+            "c_attn": {"kernel": rng.standard_normal((d, 3 * d)).astype(np.float32),
+                       "bias": rng.standard_normal((3 * d,)).astype(np.float32)},
+            "c_fc": {"kernel": rng.standard_normal((d, 4 * d)).astype(np.float32),
+                     "bias": rng.standard_normal((4 * d,)).astype(np.float32)},
+            "c_proj": {"kernel": rng.standard_normal((d, d)).astype(np.float32),
+                       "bias": rng.standard_normal((d,)).astype(np.float32)},
+            "ln_1": {"scale": np.ones((d,), np.float32)},
+        },
+        "wte": rng.standard_normal((32, d)).astype(np.float32),
+    }
+
+
+def trees_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestMpReshard:
+    def test_split_merge_roundtrip(self):
+        full = full_tree()
+        for mp in (2, 4):
+            shards = split_mp_checkpoint(full, mp)
+            assert len(shards) == mp
+            trees_equal(merge_mp_checkpoints(shards), full)
+
+    def test_qkv_slices_are_per_rank_interleaved(self):
+        """Each rank's c_attn shard must hold its q|k|v thirds — the
+        property a naive concat would break (reference qkv merge)."""
+        full = full_tree(d=8)
+        shards = split_mp_checkpoint(full, 2)
+        k = full["h_0"]["c_attn"]["kernel"]
+        q_part, k_part, v_part = np.split(k, 3, axis=1)
+        want_rank0 = np.concatenate(
+            [q_part[:, :4], k_part[:, :4], v_part[:, :4]], axis=1)
+        np.testing.assert_array_equal(
+            shards[0]["h_0"]["c_attn"]["kernel"], want_rank0)
+
+    def test_reshard_4_to_2_matches_direct_split(self):
+        full = full_tree()
+        four = split_mp_checkpoint(full, 4)
+        two_direct = split_mp_checkpoint(full, 2)
+        two_resharded = reshard_mp_checkpoint(four, 2)
+        for a, b in zip(two_direct, two_resharded):
+            trees_equal(a, b)
+
+    def test_replicated_mismatch_rejected(self):
+        full = full_tree()
+        shards = split_mp_checkpoint(full, 2)
+        shards[1]["h_0"]["ln_1"]["scale"] = np.zeros((4,), np.float32)
+        with pytest.raises(ValueError, match="replicated leaf"):
+            merge_mp_checkpoints(shards)
+
+    def test_indivisible_rejected(self):
+        full = full_tree()
+        with pytest.raises(ValueError, match="not divisible"):
+            split_mp_checkpoint(full, 3)
+
+
+class TestMpiLauncher:
+    def _args(self, launcher):
+        from deepspeed_tpu.launcher.runner import parse_args
+
+        return parse_args(["--launcher", launcher, "--master_addr", "h0",
+                           "train.py", "--flag"])
+
+    def test_openmpi_command(self):
+        from deepspeed_tpu.launcher.runner import build_mpi_command
+
+        active = OrderedDict([("h0", [0]), ("h1", [0])])
+        cmd = build_mpi_command(active, self._args("openmpi"),
+                                {"JAX_X": "1"})
+        assert cmd[0] == "mpirun"
+        assert cmd[cmd.index("-np") + 1] == "2"
+        assert "--host" in cmd and "h0:1,h1:1" in cmd
+        assert "-x" in cmd and "JAX_X=1" in cmd
+        assert "--node_rank=-1" in cmd
+        assert "train.py" in cmd and "--flag" in cmd
+
+    def test_mpich_command(self):
+        from deepspeed_tpu.launcher.runner import build_mpi_command
+
+        active = OrderedDict([("h0", [0]), ("h1", [0])])
+        cmd = build_mpi_command(active, self._args("mpich"), {"JAX_X": "1"})
+        assert "-hosts" in cmd and "h0,h1" in cmd
+        assert "-genv" in cmd
+
+    def test_mpi_rank_from_env(self, monkeypatch):
+        from deepspeed_tpu.launcher.launch import mpi_rank
+
+        monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+        assert mpi_rank() == 3
+        monkeypatch.delenv("OMPI_COMM_WORLD_RANK")
+        with pytest.raises(RuntimeError, match="MPI environment"):
+            mpi_rank()
+
+
+class TestOpRegistry:
+    def test_list_and_load(self):
+        from deepspeed_tpu.ops.registry import get_op, list_ops
+
+        ops = list_ops()
+        assert {"fused_adam", "flash_attention", "xla_attention",
+                "onebit_adam", "moq_quantizer"} <= set(ops)
+        adam_cls = get_op("fused_adam")
+        from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+        assert adam_cls is FusedAdam
+
+    def test_kind_filter_and_availability(self):
+        from deepspeed_tpu.ops.registry import list_ops
+
+        opts = list_ops(kind="optimizer")
+        assert all(s.kind == "optimizer" for s in opts.values())
+        flash = list_ops()["flash_attention"]
+        assert flash.requires_tpu and flash.pallas
+        assert flash.available() == (jax.devices()[0].platform == "tpu")
+
+    def test_unknown_op_raises(self):
+        from deepspeed_tpu.ops.registry import get_op
+
+        with pytest.raises(KeyError, match="unknown op"):
+            get_op("fused_frobnicator")
+
+    def test_env_report_lists_ops(self, capsys):
+        from deepspeed_tpu.env_report import main
+
+        main()
+        out = capsys.readouterr().out
+        assert "op registry" in out and "fused_adam" in out
+
+    def test_duplicate_registration_rejected(self):
+        from deepspeed_tpu.ops.registry import register_op
+
+        with pytest.raises(ValueError, match="already registered"):
+            register_op("fused_adam", "optimizer", lambda: None)
