@@ -1,0 +1,12 @@
+"""``deepspeed_tpu.checkpointing`` — activation-checkpointing module alias.
+
+API parity with ``deepspeed.checkpointing`` (reference
+``runtime/activation_checkpointing/checkpointing.py`` re-exported at
+package level): ``configure``, ``is_configured``, ``checkpoint``.
+"""
+
+from deepspeed_tpu.runtime.activation_checkpointing import (  # noqa: F401
+    checkpoint, configure, get_config, is_configured, remat_policy, reset)
+
+__all__ = ["configure", "is_configured", "checkpoint", "get_config",
+           "remat_policy", "reset"]

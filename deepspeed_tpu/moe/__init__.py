@@ -1,0 +1,6 @@
+"""Mixture-of-Experts + expert parallelism (planned-fresh per SURVEY §2.4;
+API follows the later deepspeed.moe.layer.MoE surface)."""
+
+from deepspeed_tpu.moe.layer import MoE, MoEConfig, moe_partition_rules
+
+__all__ = ["MoE", "MoEConfig", "moe_partition_rules"]

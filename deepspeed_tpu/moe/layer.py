@@ -1,0 +1,153 @@
+"""Mixture-of-Experts layer with expert parallelism — TPU-first.
+
+The reference snapshot (v0.4.3) predates DeepSpeed-MoE; SURVEY §2.4 marks
+EP as "build must plan fresh". The design here is the GShard/Mesh-TF
+formulation that maps natively onto a TPU mesh, matching the *later*
+DeepSpeed ``deepspeed.moe.layer.MoE`` public surface (hidden_size,
+num_experts, k, capacity_factor, aux-loss) so users of that API land
+somewhere familiar:
+
+- Experts are one stacked param tree with leading dim E, sharded over the
+  ``expert`` mesh axis (the same stacked-and-sharded pattern as the
+  pipeline's block stack).
+- Routing is dense one-hot dispatch/combine einsums (GShard): XLA lowers
+  the resharding between token-sharded and expert-sharded layouts to the
+  all-to-all the reference would issue explicitly over its expert process
+  group.
+- Top-1 (switch) or top-2 gating with capacity dropping and the standard
+  load-balancing auxiliary loss (Shazeer et al.; fraction_dispatched x
+  mean_gate x E).
+
+``MoE.__call__(x)`` returns ``(y, aux_loss)``; add ``aux_loss`` (scaled by
+your alpha) to the task loss.
+"""
+
+import math
+from dataclasses import dataclass
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.mesh import EXPERT_AXIS
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int
+    num_experts: int = 8
+    k: int = 1                        # top-k routing (1 or 2)
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    expert_intermediate: int = 0      # 0 -> 4 * hidden
+    dtype: Any = jnp.bfloat16
+    router_jitter: float = 0.0        # multiplicative input jitter (train)
+
+    @property
+    def d_ff(self) -> int:
+        return self.expert_intermediate or 4 * self.hidden_size
+
+
+class MoE(nn.Module):
+    """Switch/top-2 MoE FFN. Input [B, S, D] -> ([B, S, D], aux_loss)."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        if cfg.k not in (1, 2):
+            raise ValueError(f"k must be 1 or 2, got {cfg.k}")
+        b, s, d = x.shape
+        e = cfg.num_experts
+        tokens = b * s
+        factor = (cfg.capacity_factor if not deterministic
+                  else cfg.eval_capacity_factor)
+        capacity = max(cfg.min_capacity,
+                       int(math.ceil(tokens / e * factor)))
+
+        h = x.reshape(tokens, d)
+        if cfg.router_jitter > 0.0 and not deterministic:
+            eps = cfg.router_jitter
+            h_r = h * jax.random.uniform(self.make_rng("dropout"), h.shape,
+                                         h.dtype, 1.0 - eps, 1.0 + eps)
+        else:
+            h_r = h
+        # Router in fp32 (numerics dominate routing stability).
+        logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                          name="router")(h_r.astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)          # [T, E]
+
+        dispatch, combine, aux = _topk_dispatch(gates, cfg.k, capacity)
+
+        # Stacked expert FFN params: [E, ...] sharded over the expert axis
+        # by moe_partition_rules(); dispatch einsum reshards tokens to the
+        # expert layout (XLA emits the all-to-all on a real mesh).
+        w_in = self.param("experts_in", nn.initializers.normal(0.02),
+                          (e, d, cfg.d_ff), jnp.float32)
+        w_out = self.param("experts_out", nn.initializers.normal(0.02),
+                           (e, cfg.d_ff, d), jnp.float32)
+
+        xin = jnp.einsum("tec,td->ecd", dispatch.astype(cfg.dtype),
+                         h.astype(cfg.dtype))            # [E, C, D]
+        hmid = jnp.einsum("ecd,edf->ecf", xin, w_in.astype(cfg.dtype))
+        hmid = nn.gelu(hmid, approximate=True)
+        xout = jnp.einsum("ecf,efd->ecd", hmid, w_out.astype(cfg.dtype))
+        y = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), xout)
+        return y.reshape(b, s, d), aux
+
+
+def _topk_dispatch(gates: jax.Array, k: int, capacity: int):
+    """GShard dispatch/combine tensors + load-balance loss.
+
+    gates: [T, E] softmax. Returns (dispatch [T, E, C] 0/1,
+    combine [T, E, C] float, aux_loss scalar).
+    """
+    t, e = gates.shape
+    # Load-balance loss from the TOP-1 assignment (Switch Transformer eq. 4).
+    top1 = jnp.argmax(gates, axis=-1)
+    me = jnp.mean(gates, axis=0)                          # mean gate / expert
+    ce = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    aux = jnp.sum(me * ce) * e
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    remaining = gates
+    used = jnp.zeros((e,), jnp.int32)  # slots consumed per expert so far
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)           # [T]
+        prob = jnp.take_along_axis(remaining, choice[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)
+        # Position of each token within its chosen expert's queue,
+        # offset by slots already taken in earlier k-rounds.
+        pos = jnp.cumsum(onehot, axis=0) - onehot + used[None, :]
+        pos_tok = jnp.sum(pos * onehot, axis=-1)          # [T]
+        keep = pos_tok < capacity
+        disp = (jax.nn.one_hot(choice, e, dtype=jnp.float32)[:, :, None]
+                * jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)[:, None, :]
+                * keep[:, None, None])
+        dispatch = dispatch + disp
+        combine = combine + disp * prob[:, None, None]
+        used = used + jnp.sum(onehot * keep[:, None], axis=0)
+        remaining = remaining * (1.0 - jax.nn.one_hot(choice, e))
+    if k > 1:
+        # Top-2: renormalize combine weights over the kept assignments
+        # (GShard). Top-1 keeps the raw gate probability as the combine
+        # weight (Switch Transformer: y = p_i * E_i(x)) — normalizing it
+        # to 1 would cancel the gate from the output and kill the
+        # router's task-loss gradient.
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = jnp.where(denom > 0,
+                            combine / jnp.maximum(denom, 1e-9), 0.0)
+    return dispatch, combine, aux
+
+
+def moe_partition_rules() -> Tuple[Tuple[str, Tuple], ...]:
+    """Expert-parallel specs: stacked expert dim over the ``expert`` axis,
+    router replicated. Compose with a family's rules via concatenation."""
+    return (
+        (r".*experts_(in|out)$", (EXPERT_AXIS, None, None)),
+        (r".*router/kernel$", (None, None)),
+    )

@@ -122,21 +122,43 @@ def sim_quantize(w: jax.Array, bits, groups: int, symmetric: bool,
 
 
 class MoQQuantizer:
-    """Schedule + whole-tree sim-quantization (the engine's MoQ hook)."""
+    """Schedule + whole-tree sim-quantization (the engine's MoQ hook).
+
+    ``layer_eigenvalues`` (optional, from ``runtime/eigenvalue.py``):
+    layers with larger Hessian eigenvalues are more quantization-sensitive,
+    so their period is stretched by lambda/lambda_min — the reference's
+    eigenvalue-modulated schedule (quantize.py + engine eigenvalue hook).
+    """
 
     def __init__(self, config: MoQConfig, layer_eigenvalues=None):
         self.cfg = config
-        self.eigenvalues = layer_eigenvalues  # optional {layer: lambda_max}
         self._apply_jit = None
+        self.eigenvalues = {}
+        if layer_eigenvalues:
+            self.set_eigenvalues(layer_eigenvalues)
 
-    def current_bits(self, global_step: int) -> int:
+    def set_eigenvalues(self, layer_eigenvalues) -> None:
+        # Clamp nonpositive estimates (flat layers legitimately power-
+        # iterate to ~0) so one zero doesn't explode every other period.
+        self.eigenvalues = {k: max(float(v), 1e-6)
+                            for k, v in dict(layer_eigenvalues).items()}
+        self._lambda_min = min(self.eigenvalues.values())
+
+    def period_scale(self, layer: str = None) -> float:
+        if not self.eigenvalues or layer not in self.eigenvalues:
+            return 1.0
+        return max(self.eigenvalues[layer] / self._lambda_min, 1.0)
+
+    def current_bits(self, global_step: int, layer: str = None) -> int:
         """start_bits → target_bits, dropping 1 bit every period, period
-        doubling after each drop (reference quantize.py schedule)."""
+        doubling after each drop (reference quantize.py schedule); per-layer
+        periods stretched by the eigenvalue ratio when provided."""
         c = self.cfg
         if global_step < c.schedule_offset:
             return c.start_bits
         t = global_step - c.schedule_offset
-        bits, period = c.start_bits, c.quantize_period
+        bits = c.start_bits
+        period = c.quantize_period * self.period_scale(layer)
         while bits > c.target_bits and t >= period:
             t -= period
             period *= 2
@@ -144,21 +166,29 @@ class MoQQuantizer:
         return bits
 
     def quantize_tree(self, params: Any, global_step: int, key) -> Any:
-        bits = self.current_bits(global_step)
-        if bits >= self.cfg.start_bits and \
-                global_step < self.cfg.schedule_offset:
+        if global_step < self.cfg.schedule_offset:
             return params
         c = self.cfg
+        # Per-leaf bit widths: each leaf's TOP-LEVEL subtree name is its
+        # "layer" for the eigenvalue-stretched schedule; without
+        # eigenvalues every leaf shares the global schedule. Bits ride as
+        # a traced vector, so schedule changes never recompile.
+        paths = jax.tree_util.tree_flatten_with_path(params)[0]
+        bits = jnp.asarray(
+            [self.current_bits(
+                global_step,
+                str(getattr(p[0][0], "key", p[0][0])) if p[0] else None)
+             for p in paths], jnp.int32)
         if self._apply_jit is None:
             def apply(tree, bits, key):
                 leaves, treedef = jax.tree_util.tree_flatten(tree)
                 keys = jax.random.split(key, len(leaves))
-                out = [sim_quantize(l, bits, c.quantize_groups,
+                out = [sim_quantize(l, bits[i], c.quantize_groups,
                                     c.quantize_type == "symmetric",
                                     c.rounding == "stochastic", k)
                        if l.ndim >= 2 else l
-                       for l, k in zip(leaves, keys)]
+                       for i, (l, k) in enumerate(zip(leaves, keys))]
                 return jax.tree_util.tree_unflatten(treedef, out)
 
             self._apply_jit = jax.jit(apply, donate_argnums=(0,))
-        return self._apply_jit(params, jnp.int32(bits), key)
+        return self._apply_jit(params, bits, key)

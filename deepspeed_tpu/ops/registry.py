@@ -93,6 +93,8 @@ _builtin("onebit_adam", "optimizer",
          "deepspeed_tpu.ops.onebit.adam", "OneBitAdam")
 _builtin("onebit_lamb", "optimizer",
          "deepspeed_tpu.ops.onebit.lamb", "OneBitLamb")
+_builtin("transformer_layer", "transformer",
+         "deepspeed_tpu.ops.transformer", "DeepSpeedTransformerLayer")
 _builtin("moq_quantizer", "quantizer",
          "deepspeed_tpu.ops.quantizer", "MoQQuantizer")
 _builtin("weight_quantizer", "quantizer",

@@ -1,0 +1,2 @@
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)

@@ -129,3 +129,47 @@ class RepeatingLoader:
                 self.loader.sampler.set_epoch(self.epoch)
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
+
+
+class PrefetchLoader:
+    """Device-prefetching wrapper: while step N computes, batch N+1 is
+    already being placed onto the mesh (the TPU analogue of the reference's
+    pin_memory + async H2D; jax dispatch is async so ``put`` returns
+    immediately and the transfer overlaps compute).
+
+    ``put`` is required — pass ``engine.put_batch`` (the typical choice) or
+    any host->device placement callable.
+    """
+
+    def __init__(self, loader, put: Callable[[Any], Any],
+                 prefetch: int = 2):
+        if prefetch < 1:
+            raise ValueError("prefetch must be >= 1")
+        self.loader = loader
+        self.put = put
+        self.prefetch = prefetch
+
+    def __iter__(self):
+        import collections
+
+        queue = collections.deque()
+        it = iter(self.loader)
+
+        def refill():
+            # next() inside the guard, put() outside: a StopIteration
+            # escaping the user's put must surface, not truncate the epoch.
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            queue.append(self.put(batch))
+
+        for _ in range(self.prefetch):
+            refill()
+        while queue:
+            out = queue.popleft()
+            refill()
+            yield out
+
+    def __len__(self):
+        return len(self.loader)

@@ -809,6 +809,15 @@ class TPUEngine:
         else:
             self.flops_profiler.print_profile(prof)
 
+    def _stash_moq_probe(self, batches):
+        if (self.moq is not None
+                and self.moq.cfg.eigenvalue.get("enabled", False)
+                and isinstance(batches, dict)):
+            # one micro-batch, host-side, for the one-shot eigenvalue probe
+            self._moq_probe_batch = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[0], batches)
+        return batches
+
     def _inject_pld(self, batches):
         if self.progressive_layer_drop is None or not isinstance(batches, dict):
             return batches
@@ -820,8 +829,33 @@ class TPUEngine:
             (self.gradient_accumulation_steps,), theta, np.float32)
         return batches
 
+    def _maybe_moq_eigenvalues(self):
+        """Compute per-layer Hessian eigenvalues once at the schedule
+        offset and hand them to the quantizer (reference engine eigenvalue
+        hook: sensitive layers keep precision longer)."""
+        ev_cfg = self.moq.cfg.eigenvalue
+        if (not ev_cfg.get("enabled", False) or self.moq.eigenvalues
+                or self.global_steps < self.moq.cfg.schedule_offset
+                or getattr(self, "_moq_probe_batch", None) is None):
+            return
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        ev = Eigenvalue(verbose=ev_cfg.get("verbose", False),
+                        max_iter=int(ev_cfg.get("max_iter", 100)),
+                        tol=float(ev_cfg.get("tol", 1e-2)),
+                        stability=float(ev_cfg.get("stability", 1e-6)))
+        compute = (self._compute_params if hasattr(self, "offloader")
+                   else self.precision.cast_params(self.state.params))
+        vals = ev.compute_eigenvalue(self.loss_fn, compute,
+                                     self._moq_probe_batch,
+                                     jax.random.PRNGKey(23))
+        self.moq.set_eigenvalues(vals)
+        log_dist(f"MoQ eigenvalues: { {k: round(v, 4) for k, v in vals.items()} }",
+                 ranks=[0])
+
     def _post_step_hooks(self, loss):
         if self.moq is not None:
+            self._maybe_moq_eigenvalues()
             key = jax.random.fold_in(jax.random.PRNGKey(17), self.global_steps)
             if hasattr(self, "offloader"):
                 self.offloader.master = self.moq.quantize_tree(
@@ -860,7 +894,7 @@ class TPUEngine:
             self._post_step_hooks(loss)
             return loss
         self.tput_timer.start()
-        batches = self.put_batch(self._inject_pld(batches),
+        batches = self.put_batch(self._inject_pld(self._stash_moq_probe(batches)),
                                  leading_gas_dim=True)
         lr = self._current_lr()
         self._maybe_profile(self._train_step, self.state, batches, lr,

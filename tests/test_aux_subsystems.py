@@ -382,3 +382,75 @@ class TestReviewRegressions2:
             got = sw.swap_in("t").result()
             np.testing.assert_array_equal(got, a)
         sw.close(remove_files=True)
+
+
+class TestSmallAdditions:
+    def test_prefetch_loader_order_and_overlap(self):
+        from deepspeed_tpu.runtime.dataloader import PrefetchLoader
+
+        puts = []
+        loader = [1, 2, 3, 4, 5]
+        pl = PrefetchLoader(loader, put=lambda b: (puts.append(b), b * 10)[1],
+                            prefetch=2)
+        out = []
+        for i, b in enumerate(pl):
+            out.append(b)
+            if i == 0:
+                # two batches were placed before the first was consumed
+                assert len(puts) >= 2
+        assert out == [10, 20, 30, 40, 50]
+        assert len(pl) == 5
+
+    def test_checkpointing_alias(self):
+        import deepspeed_tpu.checkpointing as ckpt
+
+        ckpt.reset()
+        ckpt.configure(partition_activations=True)
+        assert ckpt.is_configured()
+        import jax.numpy as jnp2
+        y = ckpt.checkpoint(lambda a: a * 2, jnp2.ones((4,)))
+        np.testing.assert_array_equal(np.asarray(y), 2 * np.ones(4))
+        ckpt.reset()
+
+    def test_moq_eigenvalue_stretches_period(self):
+        from deepspeed_tpu.ops.quantizer import MoQConfig, MoQQuantizer
+
+        cfg = MoQConfig(start_bits=16, target_bits=8, quantize_period=10,
+                        schedule_offset=0)
+        q = MoQQuantizer(cfg, layer_eigenvalues={"sharp": 4.0, "flat": 1.0})
+        # flat layer drops at t=10; sharp layer's period is 4x longer
+        assert q.current_bits(10, "flat") == 15
+        assert q.current_bits(10, "sharp") == 16
+        assert q.current_bits(40, "sharp") == 15
+        # nonpositive estimates are clamped, not explosive
+        q2 = MoQQuantizer(cfg, layer_eigenvalues={"flat": 0.0, "sharp": 4.0})
+        assert q2.period_scale("sharp") <= 4.0 / 1e-6
+
+    def test_moq_engine_eigenvalue_wiring(self, rng):
+        """eigenvalue.enabled: the engine probes the Hessian once past the
+        schedule offset and layers quantize at per-layer bit widths."""
+        engine = build({"quantize_training": {
+            "enabled": True,
+            "quantize_bits": {"start_bits": 6, "target_bits": 4},
+            "quantize_schedule": {"quantize_period": 1,
+                                  "schedule_offset": 0},
+            "quantize_groups": 1,
+            "eigenvalue": {"enabled": True, "max_iter": 30}}})
+        for _ in range(3):
+            engine.train_batch(mlp_batch(rng))
+        assert engine.moq.eigenvalues, "eigenvalues never computed"
+        assert set(engine.moq.eigenvalues) == {"w1", "w2"}
+        # per-layer schedules differ when eigenvalues differ
+        b1 = engine.moq.current_bits(engine.global_steps, "w1")
+        b2 = engine.moq.current_bits(engine.global_steps, "w2")
+        assert 4 <= min(b1, b2) <= max(b1, b2) <= 6
+
+    def test_prefetch_put_error_not_swallowed(self):
+        from deepspeed_tpu.runtime.dataloader import PrefetchLoader
+
+        def bad_put(b):
+            raise StopIteration  # user bug must surface, not end the epoch
+
+        pl = PrefetchLoader([1, 2, 3], put=bad_put)
+        with pytest.raises((StopIteration, RuntimeError)):
+            list(pl)
