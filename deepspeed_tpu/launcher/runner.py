@@ -17,6 +17,7 @@ import base64
 import json
 import os
 import shlex
+import time
 import subprocess
 import sys
 from collections import OrderedDict
@@ -239,11 +240,54 @@ def main(args=None):
             full = ["ssh", host, remote]
         logger.info("launching on %s: %s", host, remote)
         procs.append(subprocess.Popen(full))
+
+    def remote_kill():
+        # Killing the local ssh/pdsh client does not reliably reach the
+        # remote workers (no tty) — issue an explicit best-effort remote
+        # pkill, the reference runner's abort path.
+        pat = shlex.quote(f"deepspeed_tpu.launcher.launch.*{args.user_script}")
+        for host in hosts:
+            try:
+                subprocess.run(["ssh", host, f"pkill -f {pat}"],
+                               timeout=10, capture_output=True)
+            except Exception:
+                pass
+
+    sys.exit(babysit(procs, on_failure=remote_kill))
+
+
+def babysit(procs, poll_interval: float = 0.5, term_timeout: float = 10.0,
+            on_failure=None) -> int:
+    """Wait on all workers; first failure terminates the rest (reference
+    launch.py sigkill_handler semantics — a dead rank would hang every
+    collective the survivors enter). SIGTERM escalates to SIGKILL after
+    ``term_timeout``; ``on_failure`` (e.g. a remote pkill) runs once on the
+    first nonzero exit."""
     rc = 0
-    for p in procs:
-        p.wait()
+    alive = list(procs)
+    while alive and rc == 0:
+        finished = [p for p in alive if p.poll() is not None]
+        for p in finished:
+            alive.remove(p)
+            if p.returncode != 0:
+                rc = p.returncode
+                logger.error("worker exited rc=%s — terminating the job", rc)
+                if on_failure is not None:
+                    on_failure()
+                for q in alive:
+                    q.terminate()
+                break
+        if not finished:
+            time.sleep(poll_interval)
+    for p in alive:
+        try:
+            p.wait(timeout=term_timeout)
+        except subprocess.TimeoutExpired:
+            logger.error("worker ignored SIGTERM — killing")
+            p.kill()
+            p.wait()
         rc = rc or p.returncode
-    sys.exit(rc)
+    return rc
 
 
 if __name__ == "__main__":

@@ -112,3 +112,67 @@ class TestEnvReport:
         assert report["packages"]["jax"] is not None
         assert report["platform"] in ("cpu", "tpu")
         assert report["features"]["zero_stages_0_3"]
+
+
+class TestBabysit:
+    def test_all_success(self):
+        import subprocess
+        import sys
+
+        from deepspeed_tpu.launcher.runner import babysit
+
+        procs = [subprocess.Popen([sys.executable, "-c", "pass"])
+                 for _ in range(3)]
+        assert babysit(procs, poll_interval=0.05) == 0
+
+    def test_failure_kills_survivors(self):
+        import subprocess
+        import sys
+        import time as _t
+
+        from deepspeed_tpu.launcher.runner import babysit
+
+        slow = subprocess.Popen([sys.executable, "-c",
+                                 "import time; time.sleep(60)"])
+        bad = subprocess.Popen([sys.executable, "-c",
+                                "import sys; sys.exit(3)"])
+        try:
+            called = []
+            t0 = _t.time()
+            rc = babysit([slow, bad], poll_interval=0.05,
+                         on_failure=lambda: called.append(1))
+            assert rc == 3
+            assert called == [1]
+            assert _t.time() - t0 < 30, "survivor was not terminated"
+            assert slow.poll() is not None
+        finally:
+            for p in (slow, bad):
+                if p.poll() is None:
+                    p.kill()
+                p.wait()
+
+    def test_sigterm_ignorer_gets_killed(self):
+        import subprocess
+        import sys
+        import time as _t
+
+        from deepspeed_tpu.launcher.runner import babysit
+
+        stubborn = subprocess.Popen([sys.executable, "-c",
+            "import signal, time; signal.signal(signal.SIGTERM, "
+            "signal.SIG_IGN); time.sleep(120)"])
+        bad = subprocess.Popen([sys.executable, "-c",
+                                "import sys; sys.exit(5)"])
+        try:
+            _t.sleep(0.3)  # let the handler install
+            t0 = _t.time()
+            rc = babysit([stubborn, bad], poll_interval=0.05,
+                         term_timeout=2.0)
+            assert rc == 5
+            assert _t.time() - t0 < 60, "SIGKILL escalation missing"
+            assert stubborn.poll() is not None
+        finally:
+            for p in (stubborn, bad):
+                if p.poll() is None:
+                    p.kill()
+                p.wait()
