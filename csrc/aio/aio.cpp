@@ -7,7 +7,10 @@
 //   - GIL released for the entire transfer (true overlap with host compute
 //     and other I/O threads; Python-side ThreadPoolExecutor provides the
 //     queue, mirroring aio_handle's thread pool),
-//   - optional O_DIRECT with 4 KiB-aligned bounce buffering for the tail,
+//   - optional O_DIRECT, taken only when the caller's buffer pointer and
+//     length are both 4 KiB-aligned (the Python swapper stages transfers
+//     through aligned, block-padded buffers so the flag engages; unaligned
+//     callers transparently fall back to buffered I/O),
 //   - single syscall-loop per tensor (no Python per-chunk overhead).
 //
 // Exposed: write_buffer(path, buffer, use_direct) -> bytes written
@@ -79,6 +82,14 @@ PyObject* write_buffer(PyObject*, PyObject* args) {
     }
 #endif
     int fd = open(path, flags, 0644);
+#ifdef O_DIRECT
+    if (fd < 0 && (flags & O_DIRECT)) {
+        // Filesystem rejects O_DIRECT (tmpfs, some NFS/overlay mounts):
+        // buffered I/O is the correctness path, the flag is a fast path.
+        flags &= ~O_DIRECT;
+        fd = open(path, flags, 0644);
+    }
+#endif
     if (fd >= 0) {
         result = write_all(fd, (const char*)buf.buf, (size_t)buf.len);
         saved_errno = errno;
@@ -114,6 +125,12 @@ PyObject* read_buffer(PyObject*, PyObject* args) {
     }
 #endif
     int fd = open(path, flags);
+#ifdef O_DIRECT
+    if (fd < 0 && (flags & O_DIRECT)) {
+        flags &= ~O_DIRECT;
+        fd = open(path, flags);
+    }
+#endif
     if (fd >= 0) {
         result = read_all(fd, (char*)buf.buf, (size_t)buf.len);
         saved_errno = errno;

@@ -129,6 +129,7 @@ class InferenceEngine:
 
         self._forward_jit = None
         self._generate_jit: Dict = {}
+        self._generate_calls = 0
 
     # ------------------------------------------------------------------
     def _default_rules(self):
@@ -183,14 +184,24 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0):
+                 seed: Optional[int] = None,
+                 attention_mask=None):
         """Autoregressive generation with a KV cache.
 
-        ``input_ids``: [B, T0] int32 prompts (uniform length — pad/bucket on
-        the host for ragged prompts). Greedy when ``temperature == 0``, else
-        temperature sampling with optional top-k. The whole prefill +
-        ``max_new_tokens``-step decode is one jitted program.
-        Returns [B, T0 + max_new_tokens].
+        ``input_ids``: [B, T0] int32 prompts. Ragged prompts must be
+        **left-padded** to a uniform T0 and accompanied by
+        ``attention_mask`` ([B, T0], 1 = real token, 0 = pad, pads leading):
+        pad slots are masked out of every attention step (prefill and the
+        whole decode) and learned positions are re-based per row so each
+        row's content starts at position 0. Without a mask, prompts are
+        taken as unpadded.
+
+        Greedy when ``temperature == 0``, else temperature sampling with
+        optional top-k. Sampling uses ``seed`` when given (byte-identical
+        outputs for the same seed); when ``seed`` is None an engine-held
+        call counter is mixed in so repeated calls draw fresh samples.
+        The whole prefill + ``max_new_tokens``-step decode is one jitted
+        program. Returns [B, T0 + max_new_tokens].
         """
         import inspect
         sig = inspect.signature(type(self.module).__call__)
@@ -212,12 +223,36 @@ class InferenceEngine:
                 f"{total} exceeds the usable context of {limit} "
                 f"(model max_seq_len / init_inference max_tokens) — "
                 f"positions past it would silently clamp")
-        key = (b, t0, int(max_new_tokens), float(temperature), int(top_k))
+        if attention_mask is not None:
+            mask = np.asarray(attention_mask)
+            if mask.shape != (b, t0):
+                raise ValueError(f"attention_mask shape {mask.shape} != "
+                                 f"{(b, t0)}")
+            if not (np.diff(mask.astype(np.int8), axis=1) >= 0).all():
+                raise ValueError("attention_mask must be left-padded "
+                                 "(0s before 1s in every row)")
+            if (mask.sum(axis=1) == 0).any():
+                raise ValueError("attention_mask has a fully-padded row — "
+                                 "every prompt needs at least one real "
+                                 "token (all-masked softmax is NaN)")
+            mask = jnp.asarray(mask, jnp.int32)
+        else:
+            mask = None
+        if seed is None:
+            # Unseeded sampled calls draw fresh samples each time (counter-
+            # mixed); greedy decoding ignores the PRNG so the counter only
+            # advances for sampled calls. seed=N reproduces the N-th
+            # unseeded sampled call byte-for-byte.
+            seed = self._generate_calls
+            if temperature > 0.0:
+                self._generate_calls += 1
+        key = (b, t0, int(max_new_tokens), float(temperature), int(top_k),
+               mask is not None)
         if key not in self._generate_jit:
             self._generate_jit[key] = jax.jit(functools.partial(
                 self._generate_impl, max_new_tokens=int(max_new_tokens),
                 temperature=float(temperature), top_k=int(top_k)))
-        return self._generate_jit[key](self.params, ids,
+        return self._generate_jit[key](self.params, ids, mask,
                                        jax.random.PRNGKey(seed))
 
     def _sample(self, logits, rng, temperature, top_k):
@@ -229,27 +264,50 @@ class InferenceEngine:
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
-    def _generate_impl(self, params, ids, rng, *, max_new_tokens,
+    def _generate_impl(self, params, ids, mask, rng, *, max_new_tokens,
                        temperature, top_k):
         from deepspeed_tpu.models.gpt import init_kv_cache
 
         cfg = self.model_cfg
         b, t0 = ids.shape
         max_len = t0 + max_new_tokens
-        p = self._materialized(params)
         cache = init_kv_cache(cfg, b, max_len, dtype=self.config.dtype)
 
-        out = self.module.apply({"params": p}, {"input_ids": ids},
-                                deterministic=True, cache=cache, pos=0)
+        # Left-padded prompts: one fixed [B, max_len] key-validity mask
+        # (pad slots never visible, generated slots always are) and per-row
+        # re-based position ids.
+        if mask is not None:
+            n_pads = (t0 - jnp.sum(mask, axis=1)).astype(jnp.int32)  # [B]
+            km = jnp.concatenate(
+                [mask, jnp.ones((b, max_new_tokens), jnp.int32)], axis=1)
+            prefill = {"input_ids": ids, "attention_mask": km,
+                       "position_ids": jnp.clip(
+                           jnp.arange(t0)[None] - n_pads[:, None], 0)}
+        else:
+            n_pads = None
+            km = None
+            prefill = {"input_ids": ids}
+
+        # Dequant happens inside each traced body (not hoisted out of the
+        # scan) so XLA fuses it into the consumer matmuls and no dense copy
+        # of the whole quantized model stays live across the decode loop.
+        out = self.module.apply({"params": self._materialized(params)},
+                                prefill, deterministic=True, cache=cache,
+                                pos=0)
         rng, sub = jax.random.split(rng)
         nxt = self._sample(out["logits"][:, -1].astype(jnp.float32), sub,
                            temperature, top_k)
 
         def step(carry, _):
             tok, cache, pos, rng = carry
-            out = self.module.apply({"params": p},
-                                    {"input_ids": tok[:, None]},
-                                    deterministic=True, cache=cache, pos=pos)
+            batch = {"input_ids": tok[:, None]}
+            if km is not None:
+                batch["attention_mask"] = km
+                batch["position_ids"] = jnp.clip(
+                    pos - n_pads, 0)[:, None]
+            out = self.module.apply({"params": self._materialized(params)},
+                                    batch, deterministic=True, cache=cache,
+                                    pos=pos)
             rng, sub = jax.random.split(rng)
             nxt = self._sample(out["logits"][:, -1].astype(jnp.float32), sub,
                                temperature, top_k)

@@ -156,7 +156,12 @@ class GPT(nn.Module):
                          (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
-        if pos is None:
+        pos_ids = batch.get("position_ids") if isinstance(batch, dict) else None
+        if pos_ids is not None:
+            # Per-row positions [B, S] — left-padded prompts re-base their
+            # learned positions so row content starts at position 0.
+            pe = jnp.take(wpe, pos_ids, axis=0)
+        elif pos is None:
             pe = wpe[:s][None]
         else:
             pe = jnp.take(wpe, pos + jnp.arange(s), axis=0)[None]
@@ -168,12 +173,18 @@ class GPT(nn.Module):
             am = batch["attention_mask"]          # [B, S] 1=keep
             if cache is not None:
                 # Cache mode: the key axis is the cache length, not this
-                # chunk. The user's [B, S] mask covers positions
-                # pos..pos+S; keys already cached (< pos) stay visible.
+                # chunk. A [B, cache_len] mask is taken as the full
+                # key-validity mask (fixed across decode — pad slots stay
+                # masked); a [B, S] mask covers positions pos..pos+S and
+                # keys already cached (< pos) stay visible.
                 lmax = cache[0][0].shape[1]
-                km = jnp.ones((b, lmax), jnp.bool_)
-                km = jax.lax.dynamic_update_slice(
-                    km, am.astype(jnp.bool_), (0, pos if pos is not None else 0))
+                if am.shape[1] == lmax:
+                    km = am.astype(jnp.bool_)
+                else:
+                    km = jnp.ones((b, lmax), jnp.bool_)
+                    km = jax.lax.dynamic_update_slice(
+                        km, am.astype(jnp.bool_),
+                        (0, pos if pos is not None else 0))
                 attn_mask = km[:, None, None, :]
             else:
                 attn_mask = am[:, None, None, :].astype(jnp.bool_)

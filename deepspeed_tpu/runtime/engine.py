@@ -882,8 +882,9 @@ class TPUEngine:
         dim gradient_accumulation_steps (one entry per micro-batch)."""
         if self._train_step is None:  # offloaded optimizer tier
             self.tput_timer.start()
-            batches = self.put_batch(self._inject_pld(batches),
-                                     leading_gas_dim=True)
+            batches = self.put_batch(
+                self._inject_pld(self._stash_moq_probe(batches)),
+                leading_gas_dim=True)
             loss = self._offload_train_batch(batches)
             self.global_steps += 1
             self.micro_steps += self.gradient_accumulation_steps

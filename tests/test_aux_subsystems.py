@@ -445,6 +445,24 @@ class TestSmallAdditions:
         b2 = engine.moq.current_bits(engine.global_steps, "w2")
         assert 4 <= min(b1, b2) <= max(b1, b2) <= 6
 
+    def test_moq_eigenvalue_under_cpu_offload(self, rng):
+        """Regression (advisor r2): the offload train_batch path must stash
+        the probe batch too, or eigenvalue modulation is silently inert."""
+        engine = build({"quantize_training": {
+            "enabled": True,
+            "quantize_bits": {"start_bits": 6, "target_bits": 4},
+            "quantize_schedule": {"quantize_period": 1,
+                                  "schedule_offset": 0},
+            "quantize_groups": 1,
+            "eigenvalue": {"enabled": True, "max_iter": 30}},
+            "zero_optimization": {
+                "offload_optimizer": {"device": "cpu"}}})
+        assert engine._train_step is None  # really on the offload tier
+        for _ in range(3):
+            engine.train_batch(mlp_batch(rng))
+        assert engine.moq.eigenvalues, \
+            "eigenvalues never computed on the offload path"
+
     def test_prefetch_put_error_not_swallowed(self):
         from deepspeed_tpu.runtime.dataloader import PrefetchLoader
 

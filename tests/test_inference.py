@@ -99,6 +99,47 @@ class TestGenerate:
         want = naive_generate(model, params, ids, 1)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    def test_left_padded_generate_matches_unpadded(self, gpt_setup):
+        """Each left-padded row must generate exactly what the same prompt
+        generates unpadded (pads invisible to attention, positions
+        re-based)."""
+        model, cfg, params, ids = gpt_setup
+        engine = deepspeed_tpu.init_inference(model, params=params,
+                                              dtype=jnp.float32)
+        pad = 3
+        padded = np.concatenate(
+            [np.zeros((2, pad), np.int32), ids], axis=1)
+        mask = np.concatenate(
+            [np.zeros((2, pad), np.int32), np.ones_like(ids)], axis=1)
+        got = np.asarray(engine.generate(padded, max_new_tokens=5,
+                                         attention_mask=mask))
+        want = np.asarray(engine.generate(ids, max_new_tokens=5))
+        np.testing.assert_array_equal(got[:, pad + ids.shape[1]:],
+                                      want[:, ids.shape[1]:])
+
+    def test_right_padded_mask_rejected(self, gpt_setup):
+        model, cfg, params, ids = gpt_setup
+        engine = deepspeed_tpu.init_inference(model, params=params,
+                                              dtype=jnp.float32)
+        mask = np.ones_like(ids)
+        mask[0, -2:] = 0  # trailing pads = right padding
+        with pytest.raises(ValueError, match="left-padded"):
+            engine.generate(ids, max_new_tokens=2, attention_mask=mask)
+
+    def test_default_seed_varies_per_call(self, gpt_setup):
+        model, cfg, params, ids = gpt_setup
+        engine = deepspeed_tpu.init_inference(model, params=params,
+                                              dtype=jnp.float32)
+        a = np.asarray(engine.generate(ids, max_new_tokens=8,
+                                       temperature=1.5))
+        b = np.asarray(engine.generate(ids, max_new_tokens=8,
+                                       temperature=1.5))
+        c = np.asarray(engine.generate(ids, max_new_tokens=8,
+                                       temperature=1.5, seed=0))
+        # seed=0 reproduces call #0; unseeded calls differ from each other
+        np.testing.assert_array_equal(a, c)
+        assert not np.array_equal(a, b)
+
 
 class TestTensorParallel:
     def test_tp2_matches_single(self, gpt_setup, eight_devices):

@@ -1,0 +1,158 @@
+"""Round-3 perf probe — component ablation for the GPT-2 / BERT-seq512 MFU gap.
+
+Methodology (see memory: scalar-fence timings through the axon tunnel):
+each variant is one jitted fwd+bwd+adam step; 10 timed steps after 2 warmup,
+window closed by a scalar fetch. Analytic FLOPs as in bench.py.
+
+Variants isolate where the time goes:
+  full        — model loss as shipped (fp32 [B,S,V] logits + fp32 log_softmax)
+  nollhead    — loss = mean(hidden) before the lm head (no head matmul, no CE)
+  logitsum    — loss = mean(logits) (head matmul paid, CE skipped)
+  xla-attn    — full, attention impl forced to xla
+  pallas-attn — full, attention impl forced to pallas
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, "/root/repo")
+from deepspeed_tpu.models import make_bert, make_gpt  # noqa: E402
+
+PEAK = 197.0
+
+
+def log(msg):
+    print(msg, flush=True)
+
+
+def fence(x):
+    return float(jnp.sum(jax.tree_util.tree_leaves(x)[0]).astype(jnp.float32))
+
+
+def timed(step, params, opt_state, batch, steps=10, warmup=2):
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, batch)
+    fence(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    fence(loss)
+    return (time.perf_counter() - t0) / steps
+
+
+def run_variant(name, model, params, batch, loss_mode, flops):
+    tx = optax.adam(1e-4)
+    opt_state = tx.init(params)
+
+    def loss_fn(p):
+        out = model.apply({"params": p}, batch, deterministic=True)
+        if loss_mode == "full":
+            return out["loss"]
+        if loss_mode == "logitsum":
+            return jnp.mean(out["logits"].astype(jnp.float32))
+        raise ValueError(loss_mode)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(p, s, b):
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        up, s = tx.update(g, s, p)
+        return optax.apply_updates(p, up), s, loss
+
+    t0 = time.time()
+    dt = timed(step, params, opt_state, batch)
+    tf = flops / dt / 1e12
+    log(f"[probe] {name:28s} {dt*1e3:7.1f} ms/step  {tf:6.1f} TF/s  "
+        f"MFU {tf/PEAK:5.1%}  (compile+run {time.time()-t0:.0f}s)")
+    return dt
+
+
+def flops_for(n_params, tokens, seq, hidden, layers):
+    return 6.0 * n_params * tokens + 12.0 * layers * hidden * seq * tokens
+
+
+def count(tree):
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
+
+
+def probe_gpt():
+    bs, seq = 16, 512
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 50257, (bs, seq), dtype=np.int32)}
+    results = {}
+    for name, over in [
+        ("gpt2 full (auto attn)", {}),
+        ("gpt2 xla attn", {"attention_impl": "xla"}),
+        ("gpt2 pallas attn", {"attention_impl": "pallas"}),
+    ]:
+        model, cfg = make_gpt("gpt2", dropout_rate=0.0, remat=False,
+                              max_seq_len=512, **over)
+        params = model.init({"params": jax.random.PRNGKey(0)},
+                            batch, deterministic=True)["params"]
+        n = count(params)
+        fl = flops_for(n, bs * seq, seq, cfg.hidden_size, cfg.num_layers)
+        results[name] = run_variant(name, model, params, batch, "full", fl)
+    # head-cost isolation: same model, logits-sum loss (CE skipped)
+    model, cfg = make_gpt("gpt2", dropout_rate=0.0, remat=False,
+                          max_seq_len=512)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        batch, deterministic=True)["params"]
+    n = count(params)
+    fl = flops_for(n, bs * seq, seq, cfg.hidden_size, cfg.num_layers)
+    results["gpt2 logitsum (no CE)"] = run_variant(
+        "gpt2 logitsum (no CE)", model, params, batch, "logitsum", fl)
+    # tiny-vocab: isolates embed+head+CE cost jointly (flops adjusted)
+    model, cfg = make_gpt("gpt2", dropout_rate=0.0, remat=False,
+                          max_seq_len=512, vocab_size=2048)
+    batch2 = {"input_ids": rng.integers(0, 2048, (bs, seq), dtype=np.int32)}
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        batch2, deterministic=True)["params"]
+    n = count(params)
+    fl = flops_for(n, bs * seq, seq, cfg.hidden_size, cfg.num_layers)
+    results["gpt2 vocab2048 full"] = run_variant(
+        "gpt2 vocab2048 full", model, params, batch2, "full", fl)
+    return results
+
+
+def probe_bert():
+    bs, seq = 8, 512
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 30522, (bs, seq), dtype=np.int32)
+    labels = np.where(rng.random((bs, seq)) < 0.15, ids, -100)
+    batch = {"input_ids": ids,
+             "attention_mask": np.ones((bs, seq), np.int32),
+             "labels": labels.astype(np.int32)}
+    for name, over in [
+        ("bert512 full (auto attn)", {}),
+        ("bert512 xla attn", {"attention_impl": "xla"}),
+        ("bert512 pallas attn", {"attention_impl": "pallas"}),
+    ]:
+        model, cfg = make_bert("bert-large", dropout_rate=0.0, remat=False,
+                               max_seq_len=512, **over)
+        params = model.init({"params": jax.random.PRNGKey(0),
+                             "dropout": jax.random.PRNGKey(1)},
+                            batch)["params"]
+        n = count(params)
+        fl = flops_for(n, bs * seq, seq, cfg.hidden_size, cfg.num_layers)
+        run_variant(name, model, params, batch, "full", fl)
+    # no-mask variant: does the [B,S] all-ones mask block the flash path or
+    # cost anything?
+    model, cfg = make_bert("bert-large", dropout_rate=0.0, remat=False,
+                           max_seq_len=512)
+    b2 = {"input_ids": ids, "labels": labels.astype(np.int32)}
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)}, b2)["params"]
+    n = count(params)
+    fl = flops_for(n, bs * seq, seq, cfg.hidden_size, cfg.num_layers)
+    run_variant("bert512 no mask", model, params, b2, "full", fl)
+
+
+if __name__ == "__main__":
+    log(f"devices: {jax.devices()}")
+    probe_gpt()
+    probe_bert()
