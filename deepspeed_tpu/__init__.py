@@ -57,6 +57,38 @@ def initialize(args=None,
     if dist_init_required:
         init_distributed()
 
+    if cfg.zero_config.offload_param.enabled and loss_fn is not None:
+        raise ValueError(
+            "offload_param cannot stream an opaque loss_fn (no per-block "
+            "fetch points): pass model= (a PipeModel or an in-tree GPT) and "
+            "let initialize() build the streamed loss, or — if your loss_fn "
+            "already fetches from host memory itself — construct TPUEngine "
+            "directly")
+    if cfg.zero_config.offload_param.enabled and loss_fn is None:
+        # ZeRO-Infinity param tier: the step streams blocks from host
+        # memory, which needs per-block fetch points — a block-structured
+        # PipeModel, not an opaque module (the reference likewise needs
+        # nn.Module boundaries for its fetch hooks, stage3.py:1084).
+        from deepspeed_tpu.parallel.pipe.module import (PipeModel,
+                                                        gpt_pipe_model)
+        from deepspeed_tpu.runtime.zero.param_offload import \
+            build_streamed_loss
+
+        if isinstance(model, PipeModel):
+            pm = model
+            if params is not None:  # e.g. restored weights, pipe layout
+                pm.params = params
+        else:
+            from deepspeed_tpu.models.gpt import GPT
+
+            if isinstance(model, GPT):
+                pm = gpt_pipe_model(model.cfg, params=params)
+            else:
+                raise ValueError(
+                    "offload_param needs a block-structured model: pass a "
+                    "PipeModel (parallel.pipe.module) or an in-tree GPT; "
+                    "opaque modules/loss_fns have no per-block fetch points")
+        loss_fn, params = build_streamed_loss(pm), pm.params
     if loss_fn is None:
         if model is None:
             raise ValueError("initialize() needs either loss_fn+params or model")

@@ -154,7 +154,13 @@ class BertModel(nn.Module):
         loss = jnp.float32(0.0)
         labels = batch.get("labels")
         if labels is not None:
-            loss = cross_entropy_with_ignore(logits, labels)
+            # Fused CE head (ops/xent.py): avoids the [B,S,V] fp32
+            # log-softmax materializations; `logits` above is DCE'd by XLA
+            # when the caller uses only the loss.
+            from deepspeed_tpu.ops.xent import fused_cross_entropy
+            loss = fused_cross_entropy(h.astype(cfg.dtype),
+                                       wte.astype(cfg.dtype), labels,
+                                       bias=mlm_bias)
         nsp = batch.get("next_sentence_label")
         if nsp is not None:
             pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.transformer.attention import attention
+from deepspeed_tpu.ops.xent import fused_cross_entropy
 
 
 @dataclass(frozen=True)
@@ -223,7 +224,20 @@ class GPT(nn.Module):
 
         if cache is not None:
             return {"logits": logits, "cache": tuple(new_cache)}
-        loss = cross_entropy_with_ignore(logits, shift_labels(batch))
+        # Loss goes through the fused CE head (ops/xent.py): compute-dtype
+        # logits, lse-only residual, backward recompute — the [B,S,V] fp32
+        # materializations are the single biggest HBM sink at GPT-2 scale
+        # (PROFILE.md). The `logits` output above is untouched; XLA
+        # dead-code-eliminates it whenever the caller only uses the loss.
+        # (A caller reading BOTH loss and logits pays the head matmul twice
+        # — the fp32-logits einsum and the fused op's compute-dtype one
+        # can't CSE; acceptable for eval loops, free for training.)
+        labels = shift_labels(batch)
+        if cfg.tie_embeddings:
+            loss = fused_cross_entropy(x.astype(cfg.dtype),
+                                       wte.astype(cfg.dtype), labels)
+        else:
+            loss = cross_entropy_with_ignore(logits, labels)
         return {"loss": loss, "logits": logits}
 
 

@@ -10,7 +10,11 @@ matmuls + block softmax (``ops/sparse_attention/matmul.py``,
   q-block), loops ONLY over that row's active kv-blocks. The active-index
   list is precomputed on the host from the (static) layout, so compute and
   HBM traffic scale with layout density — the O(s·√s) long-sequence story
-  of the reference (docs/index.md:142), TPU-style.
+  of the reference (docs/index.md:142), TPU-style. Training goes through a
+  custom VJP whose dq / dk+dv kernels walk the layout (and its transpose)
+  exactly like the reference's Triton SDD/DSD/DDS backward modes
+  (matmul.py:749, trsrc/softmax_bwd.tr) — peak memory stays density-
+  scaled in backward too.
 
 Layouts come from ``sparsity_config.py`` as [H, B, B] int32.
 """
@@ -46,6 +50,15 @@ def layout_kv_indices(layout: np.ndarray):
     return idx, max_active
 
 
+def layout_q_indices(layout: np.ndarray):
+    """Transpose layout: per (head, kv-block) active Q-block ids, padded
+    with -1 — the dk/dv backward iteration order (the reference runs its
+    Triton matmuls with a transposed layout for the same purpose,
+    ops/sparse_attention/matmul.py:749 ``mode`` dsd/dds)."""
+    layout = np.asarray(layout)
+    return layout_kv_indices(layout.transpose(0, 2, 1))
+
+
 def _xla_sparse(q, k, v, layout, block, causal, scale):
     mask = jnp.asarray(layout_to_dense_mask(layout, block))   # [H, S, S]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -62,12 +75,16 @@ def _xla_sparse(q, k, v, layout, block, causal, scale):
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
 
 
-def _sparse_kernel(kv_idx_ref, q_ref, k_ref, v_ref, o_ref, *,
+LANES = 128  # per-row lse/delta broadcast across lanes for (8,128) tiling
+
+
+def _sparse_kernel(kv_idx_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                    causal: bool, scale: float, block: int, num_heads: int,
                    max_active: int):
     """grid: (B*H, q_blocks). Refs: q [1, block, D]; k/v [1, S, D];
     kv_idx [H, qb, max_active] in SMEM (scalar-prefetched — SMEM supports
-    the arbitrary dynamic indexing a layout lookup needs)."""
+    the arbitrary dynamic indexing a layout lookup needs). Saves per-row
+    logsumexp for the backward recomputation."""
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     h = jax.lax.rem(bh, num_heads)
@@ -107,37 +124,241 @@ def _sparse_kernel(kv_idx_ref, q_ref, k_ref, v_ref, o_ref, *,
     m, l, acc = jax.lax.fori_loop(0, max_active, body, init)
     out = jnp.where((l > 0)[:, None], acc / jnp.maximum(l, 1e-30)[:, None], 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
+    # Fully-masked rows keep lse ~ NEG_INF; the backward guards on it.
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], (block, LANES))
+
+
+def _sparse_bwd_dq_kernel(kv_idx_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, dq_ref, *, causal: bool, scale: float,
+                          block: int, num_heads: int, max_active: int):
+    """dq over (B*H, q_blocks): loop this row's active kv-blocks, recompute
+    p from the saved lse, ds = p (dp - delta), dq += ds @ k. Mirrors the
+    flash _bwd_dq_kernel but walks the layout's active list."""
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    h = jax.lax.rem(bh, num_heads)
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = jnp.maximum(lse_ref[0, :, 0], NEG_INF / 2)   # guard empty rows
+    delta = delta_ref[0, :, 0]
+
+    def body(j, dq):
+        ki = kv_idx_ref[h, qi, j]
+        active = ki >= 0
+        ki_safe = jnp.maximum(ki, 0)
+        kblk = k_ref[0, pl.ds(ki_safe * block, block), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(ki_safe * block, block), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_pos = ki_safe * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(active, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, kblk, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, max_active, body,
+                           jnp.zeros((block, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _sparse_bwd_dkv_kernel(q_idx_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                           delta_ref, dk_ref, dv_ref, *, causal: bool,
+                           scale: float, block: int, num_heads: int,
+                           max_active: int):
+    """dk/dv over (B*H, kv_blocks): loop this column's active q-blocks via
+    the TRANSPOSE layout (layout_q_indices); dv += pᵀ @ dO,
+    dk += dsᵀ @ q."""
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+    h = jax.lax.rem(bh, num_heads)
+    d = k_ref.shape[2]
+    kblk = k_ref[0].astype(jnp.float32)
+    vblk = v_ref[0].astype(jnp.float32)
+
+    def body(j, carry):
+        dk, dv = carry
+        qi = q_idx_ref[h, ki, j]
+        active = qi >= 0
+        qi_safe = jnp.maximum(qi, 0)
+        q = q_ref[0, pl.ds(qi_safe * block, block), :].astype(
+            jnp.float32) * scale
+        do = do_ref[0, pl.ds(qi_safe * block, block), :].astype(jnp.float32)
+        lse = jnp.maximum(lse_ref[0, pl.ds(qi_safe * block, block), 0],
+                          NEG_INF / 2)
+        delta = delta_ref[0, pl.ds(qi_safe * block, block), 0]
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi_safe * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_pos = ki * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(active, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                        # [bq, bk]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        0, max_active, body,
+        (jnp.zeros((block, d), jnp.float32),
+         jnp.zeros((block, d), jnp.float32)))
+    # q rides pre-scaled into ds, so dk = dsᵀ @ (q·scale) already carries
+    # the softmax scale — no extra factor (unlike dq, whose ds @ k product
+    # has no scale in it).
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _sparse_forward(qf, kf, vf, kv_idx, max_active, block, causal, scale,
+                    num_heads, interpret):
+    bh, s, d = qf.shape
+    qb = s // block
+    kernel = functools.partial(_sparse_kernel, causal=causal, scale=scale,
+                               block=block, num_heads=num_heads,
+                               max_active=max_active)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,       # kv_idx rides in SMEM
+        grid=(bh, qb),
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda b, i, idx: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i, idx: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i, idx: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, d), lambda b, i, idx: (b, i, 0)),
+            pl.BlockSpec((1, block, LANES), lambda b, i, idx: (b, i, 0)),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, s, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kv_idx, qf, kf, vf)
+    return out, lse
+
+
+def _sparse_backward(qf, kf, vf, do, out, lse, kv_idx, q_idx, max_active_kv,
+                     max_active_q, block, causal, scale, num_heads,
+                     interpret):
+    bh, s, d = qf.shape
+    qb = s // block
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
+
+    dq = pl.pallas_call(
+        functools.partial(_sparse_bwd_dq_kernel, causal=causal, scale=scale,
+                          block=block, num_heads=num_heads,
+                          max_active=max_active_kv),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, qb),
+            in_specs=[
+                pl.BlockSpec((1, block, d), lambda b, i, idx: (b, i, 0)),
+                pl.BlockSpec((1, s, d), lambda b, i, idx: (b, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda b, i, idx: (b, 0, 0)),
+                pl.BlockSpec((1, block, d), lambda b, i, idx: (b, i, 0)),
+                pl.BlockSpec((1, block, LANES), lambda b, i, idx: (b, i, 0)),
+                pl.BlockSpec((1, block, LANES), lambda b, i, idx: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block, d), lambda b, i, idx: (b, i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), qf.dtype),
+        interpret=interpret,
+    )(kv_idx, qf, kf, vf, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_sparse_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block=block, num_heads=num_heads,
+                          max_active=max_active_q),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, qb),
+            in_specs=[
+                pl.BlockSpec((1, s, d), lambda b, i, idx: (b, 0, 0)),
+                pl.BlockSpec((1, block, d), lambda b, i, idx: (b, i, 0)),
+                pl.BlockSpec((1, block, d), lambda b, i, idx: (b, i, 0)),
+                pl.BlockSpec((1, s, d), lambda b, i, idx: (b, 0, 0)),
+                pl.BlockSpec((1, s, LANES), lambda b, i, idx: (b, 0, 0)),
+                pl.BlockSpec((1, s, LANES), lambda b, i, idx: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block, d), lambda b, i, idx: (b, i, 0)),
+                pl.BlockSpec((1, block, d), lambda b, i, idx: (b, i, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), vf.dtype),
+        ],
+        interpret=interpret,
+    )(q_idx, qf, kf, vf, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.lru_cache(maxsize=64)
+def _sparse_vjp_fn(layout_key, block, causal, scale, interpret):
+    """Build (and cache) a differentiable [B*H, S, D]-layout sparse
+    attention closure for one static layout. The layout rides in the cache
+    key as bytes (custom_vjp nondiff args must be hashable)."""
+    layout_bytes, h, nb = layout_key
+    layout = np.frombuffer(layout_bytes, np.int8).reshape(h, nb, nb)
+    kv_idx_np, max_kv = layout_kv_indices(layout)
+    q_idx_np, max_q = layout_q_indices(layout)
+    kv_idx = jnp.asarray(kv_idx_np)
+    q_idx = jnp.asarray(q_idx_np)
+
+    @jax.custom_vjp
+    def fn(qf, kf, vf):
+        out, _ = _sparse_forward(qf, kf, vf, kv_idx, max_kv, block, causal,
+                                 scale, h, interpret)
+        return out
+
+    def fwd(qf, kf, vf):
+        out, lse = _sparse_forward(qf, kf, vf, kv_idx, max_kv, block, causal,
+                                   scale, h, interpret)
+        return out, (qf, kf, vf, out, lse)
+
+    def bwd(res, g):
+        qf, kf, vf, out, lse = res
+        return _sparse_backward(qf, kf, vf, g, out, lse, kv_idx, q_idx,
+                                max_kv, max_q, block, causal, scale, h,
+                                interpret)
+
+    fn.defvjp(fwd, bwd)
+    return fn
 
 
 def _pallas_sparse(q, k, v, layout, block, causal, scale, interpret):
     b, s, h, d = q.shape
-    kv_idx, max_active = layout_kv_indices(np.asarray(layout))
-    qb = s // block
+    layout = np.asarray(layout).astype(np.int8)
 
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    qf, kf, vf = to_bhsd(q), to_bhsd(k), to_bhsd(v)
-
-    kernel = functools.partial(_sparse_kernel, causal=causal, scale=scale,
-                               block=block, num_heads=h,
-                               max_active=max_active)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,       # kv_idx rides in SMEM
-        grid=(b * h, qb),
-        in_specs=[
-            pl.BlockSpec((1, block, d), lambda bh, i, idx: (bh, i, 0)),
-            pl.BlockSpec((1, s, d), lambda bh, i, idx: (bh, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bh, i, idx: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block, d), lambda bh, i, idx: (bh, i, 0)),
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        interpret=interpret,
-    )(jnp.asarray(kv_idx), qf, kf, vf)
+    key = (layout.tobytes(), layout.shape[0], layout.shape[1])
+    fn = _sparse_vjp_fn(key, int(block), bool(causal), float(scale),
+                        bool(interpret))
+    out = fn(to_bhsd(q), to_bhsd(k), to_bhsd(v))
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 

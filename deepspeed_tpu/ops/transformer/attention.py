@@ -101,17 +101,18 @@ def _as_kv_mask(mask, batch, sk):
 #   seq 4096: XLA  37 vs pallas 78          -> pallas
 # Short sequences stay on XLA's fused materialized attention (tiny score
 # tensors, better fusion with the surrounding matmuls); from 512 keys up
-# the O(S) streaming kernel wins on both time and memory. Overridable with
-# impl="pallas"/"xla".
+# the O(S) streaming kernel wins on both time and memory. With attention
+# dropout ON the gap widens further (the xla path adds bernoulli + an
+# [S,S] mask; in-kernel hash dropout costs ~2%): measured r3, fwd+bwd
+# 8-layer stacks — seq 512: 19.7 vs 32.8 ms; 1024: 23.8 vs 56.1;
+# 2048: 25.9 vs 101.3 (PROFILE.md). Overridable with impl="pallas"/"xla".
 PALLAS_MIN_SEQ_K = 512
 
 
-def _pallas_ok(q, k, causal, bias, mask, dropout_rate, deterministic):
+def _pallas_ok(q, k, bias, mask):
     if bias is not None:
         return False
     if mask is not None and _as_kv_mask(mask, q.shape[0], k.shape[1]) is None:
-        return False
-    if dropout_rate > 0.0 and not deterministic:
         return False
     sq, sk = q.shape[1], k.shape[1]
     if not (sq % 128 == 0 and sk % 128 == 0 and q.shape[-1] in
@@ -132,8 +133,8 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
               impl: str = "auto") -> jax.Array:
     """Dispatching attention entry point used by every model family."""
     if impl == "auto":
-        impl = ("pallas" if _on_tpu() and _pallas_ok(
-            q, k, causal, bias, mask, dropout_rate, deterministic) else "xla")
+        impl = ("pallas" if _on_tpu() and _pallas_ok(q, k, bias, mask)
+                else "xla")
     if impl == "pallas":
         kv_mask = _as_kv_mask(mask, q.shape[0], k.shape[1])
         if bias is not None or (mask is not None and kv_mask is None):
@@ -141,13 +142,13 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                              "key-padding masks ([B, Sk] / [B,1,1,Sk]) — "
                              "use impl='xla' for general masks/bias (or "
                              "sparse attention for layout masks)")
-        if dropout_rate > 0.0 and not deterministic:
-            raise ValueError("impl='pallas' flash attention does not apply "
-                             "attention dropout — use impl='xla'")
         from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
 
+        rate = dropout_rate if (dropout_rate > 0.0 and not deterministic) \
+            else 0.0
         return flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
-                               softmax_scale=softmax_scale)
+                               softmax_scale=softmax_scale,
+                               dropout_rate=rate, dropout_rng=dropout_rng)
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, bias=bias, mask=mask,
                              dropout_rate=dropout_rate, dropout_rng=dropout_rng,

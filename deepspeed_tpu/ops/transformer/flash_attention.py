@@ -43,16 +43,62 @@ def _use_interpret() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Attention dropout — counter-based hash PRNG
+# ---------------------------------------------------------------------------
+# The reference applies attention dropout inside its fused kernels
+# (csrc/transformer/dropout_kernels.cu, ds_transformer_cuda.cpp:168-190).
+# Here the keep-mask is a pure function of (seed, batch·head, absolute row,
+# absolute col) via a murmur3-style integer hash — vector int ops that run
+# identically inside the Mosaic kernel, in the Pallas interpreter, and in
+# plain jnp (`dropout_keep_mask` is the oracle the parity tests use). The
+# backward kernels regenerate exactly the forward's mask because the hash
+# depends only on absolute element coordinates, not the block walk order.
+
+def _hash_u32(x):
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0x27D4EB2F)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _dropout_bits(seed, bh, rows, cols):
+    """uint32 hash bits for absolute element coordinates. rows/cols are
+    int32 arrays broadcastable to the score-block shape."""
+    x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         + cols.astype(jnp.uint32) * jnp.uint32(0x7FEB352D))
+    x = x ^ (jnp.asarray(seed).astype(jnp.uint32) + jnp.uint32(0x165667B1))
+    x = x ^ (jnp.asarray(bh).astype(jnp.uint32) * jnp.uint32(0x58F633B5)
+             + jnp.uint32(1))
+    return _hash_u32(x)
+
+
+def dropout_keep_mask(seed, bh, rows, cols, rate: float):
+    """Boolean keep-mask for attention dropout — the single source of truth
+    shared by the kernels and the jnp oracle (tests/test_flash_attention).
+    seed: int32 scalar; bh: batch·head index; rows/cols: absolute score
+    coordinates (broadcastable int32 arrays)."""
+    bits = _dropout_bits(seed, bh, rows, cols)
+    # top 24 bits vs an integer threshold — Mosaic has no uint32->float
+    # cast, and the int32 compare is cheaper anyway (>>8 keeps it positive).
+    thresh = int(float(rate) * (1 << 24))
+    return (bits >> 8).astype(jnp.int32) >= thresh
+
+
+# ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(*refs, causal: bool, scale: float, block_k: int, seq_q: int,
-                seq_k: int, has_mask: bool):
+def _fwd_kernel(seed_ref, *refs, causal: bool, scale: float, block_k: int,
+                seq_q: int, seq_k: int, has_mask: bool, dropout_rate: float):
     if has_mask:
         q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref = refs
     else:
         q_ref, k_ref, v_ref, o_ref, lse_ref = refs
         mask_ref = None
+    bh_idx = pl.program_id(0)
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
@@ -74,11 +120,11 @@ def _fwd_kernel(*refs, causal: bool, scale: float, block_k: int, seq_q: int,
         v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [bq, bk]
+        q_idx = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_idx = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
-            q_idx = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_idx + offset >= k_idx, s, NEG_INF)
         m_cur = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -90,9 +136,19 @@ def _fwd_kernel(*refs, causal: bool, scale: float, block_k: int, seq_q: int,
             km = mask_ref[0, :, pl.ds(ki * block_k, block_k)]
             p = p * km
         alpha = jnp.exp(m_prev - m_new)
+        # Dropout applies to the accumulated probabilities only — the
+        # normaliser keeps the full softmax mass, matching post-softmax
+        # dropout semantics (reference dropout_kernels.cu applies it to the
+        # normalised probs; here l normalises first, then D p v sums).
+        if dropout_rate > 0.0:
+            keep = dropout_keep_mask(seed_ref[0], bh_idx, q_idx, k_idx,
+                                     dropout_rate)
+            p_acc = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+        else:
+            p_acc = p
         l_new = l_prev * alpha + jnp.sum(p, axis=1)
         acc = acc * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p_acc, v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
     init = (jnp.full((block_q,), NEG_INF, jnp.float32),
@@ -106,40 +162,43 @@ def _fwd_kernel(*refs, causal: bool, scale: float, block_k: int, seq_q: int,
 
 
 def _flash_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
-                   interpret, nheads=1):
+                   interpret, nheads=1, dropout_rate=0.0, seed=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    grid = (bh, sq // block_q)
     has_mask = kv_mask is not None
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                block_k=block_k, seq_q=sq, seq_k=sk,
-                               has_mask=has_mask)
+                               has_mask=has_mask, dropout_rate=dropout_rate)
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, s: (b, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i, s: (b, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i, s: (b, 0, 0)),
     ]
     inputs = [q, k, v]
     if has_mask:
         # Mask rides as [B, 1, Sk] so the (1, 1, Sk) block's trailing dims
         # equal the array's (TPU mosaic tiling constraint for sub-8 rows).
         in_specs.append(
-            pl.BlockSpec((1, 1, sk), lambda b, i: (b // nheads, 0, 0)))
+            pl.BlockSpec((1, 1, sk), lambda b, i, s: (b // nheads, 0, 0)))
         inputs.append(kv_mask)
     out, lse = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,     # dropout seed rides in SMEM
+            grid=(bh, sq // block_q),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, s: (b, i, 0)),
+                pl.BlockSpec((1, block_q, LANES), lambda b, i, s: (b, i, 0)),
+            ]),
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(*inputs)
+    )(seed, *inputs)
     return out, lse
 
 
@@ -147,14 +206,16 @@ def _flash_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
 # Backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(*refs, causal: bool, scale: float, block_k: int,
-                   seq_q: int, seq_k: int, has_mask: bool):
+def _bwd_dq_kernel(seed_ref, *refs, causal: bool, scale: float, block_k: int,
+                   seq_q: int, seq_k: int, has_mask: bool,
+                   dropout_rate: float):
     if has_mask:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
          dq_ref) = refs
     else:
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
         mask_ref = None
+    bh_idx = pl.program_id(0)
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
@@ -176,17 +237,24 @@ def _bwd_dq_kernel(*refs, causal: bool, scale: float, block_k: int,
         v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        q_idx = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_idx = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
-            q_idx = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_idx + offset >= k_idx, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         if mask_ref is not None:
             p = p * mask_ref[0, :, pl.ds(ki * block_k, block_k)]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        # With dropout D: o = Σ D p̂ v / l, and Σ_j p̂_j D_j dp_j = do·o =
+        # delta still holds, so ds = p (D∘dp − delta) — regenerate the
+        # forward's exact keep-mask from the hash.
+        if dropout_rate > 0.0:
+            keep = dropout_keep_mask(seed_ref[0], bh_idx, q_idx, k_idx,
+                                     dropout_rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta[:, None])
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -194,8 +262,9 @@ def _bwd_dq_kernel(*refs, causal: bool, scale: float, block_k: int,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, causal: bool, scale: float, block_q: int,
-                    seq_q: int, seq_k: int, has_mask: bool):
+def _bwd_dkv_kernel(seed_ref, *refs, causal: bool, scale: float, block_q: int,
+                    seq_q: int, seq_k: int, has_mask: bool,
+                    dropout_rate: float):
     if has_mask:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
          dk_ref, dv_ref) = refs
@@ -203,6 +272,7 @@ def _bwd_dkv_kernel(*refs, causal: bool, scale: float, block_q: int,
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref) = refs
         mask_ref = None
+    bh_idx = pl.program_id(0)
     ki = pl.program_id(1)
     block_k = k_ref.shape[1]
     d = k_ref.shape[2]
@@ -224,19 +294,27 @@ def _bwd_dkv_kernel(*refs, causal: bool, scale: float, block_q: int,
         delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        q_idx = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_idx = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
-            q_idx = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_idx + offset >= k_idx, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                       # [bq, bk]
         if mask_ref is not None:
             p = p * mask_ref[0]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = dropout_keep_mask(seed_ref[0], bh_idx, q_idx, k_idx,
+                                     dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_acc = jnp.where(keep, p * inv, 0.0)   # dropped probs for dv
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            p_acc = p
+        dv = dv + jax.lax.dot_general(p_acc, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -251,8 +329,8 @@ def _bwd_dkv_kernel(*refs, causal: bool, scale: float, block_q: int,
 
 
 def _flash_backward(res, g, causal, scale, block_q, block_k, interpret,
-                    nheads=1):
-    q, k, v, kv_mask, out, lse = res
+                    nheads=1, dropout_rate=0.0):
+    q, k, v, kv_mask, out, lse, seed = res
     bh, sq, d = q.shape
     sk = k.shape[1]
     do = g
@@ -261,58 +339,63 @@ def _flash_backward(res, g, causal, scale, block_q, block_k, interpret,
     has_mask = kv_mask is not None
 
     dq_in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, s: (b, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i, s: (b, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i, s: (b, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, s: (b, i, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, i, s: (b, i, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, i, s: (b, i, 0)),
     ]
     dq_inputs = [q, k, v, do, lse, delta]
     if has_mask:
         dq_in_specs.append(
-            pl.BlockSpec((1, 1, sk), lambda b, i: (b // nheads, 0, 0)))
+            pl.BlockSpec((1, 1, sk), lambda b, i, s: (b // nheads, 0, 0)))
         dq_inputs.append(kv_mask)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                           block_k=block_k, seq_q=sq, seq_k=sk,
-                          has_mask=has_mask),
-        grid=(bh, sq // block_q),
-        in_specs=dq_in_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                          has_mask=has_mask, dropout_rate=dropout_rate),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, sq // block_q),
+            in_specs=dq_in_specs,
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda b, i, s: (b, i, 0))),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         interpret=interpret,
-    )(*dq_inputs)
+    )(seed, *dq_inputs)
 
     dkv_in_specs = [
-        pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, sq, LANES), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, sq, LANES), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, sq, d), lambda b, i, s: (b, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, s: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, s: (b, i, 0)),
+        pl.BlockSpec((1, sq, d), lambda b, i, s: (b, 0, 0)),
+        pl.BlockSpec((1, sq, LANES), lambda b, i, s: (b, 0, 0)),
+        pl.BlockSpec((1, sq, LANES), lambda b, i, s: (b, 0, 0)),
     ]
     dkv_inputs = [q, k, v, do, lse, delta]
     if has_mask:
         dkv_in_specs.append(
-            pl.BlockSpec((1, 1, block_k), lambda b, i: (b // nheads, 0, i)))
+            pl.BlockSpec((1, 1, block_k), lambda b, i, s: (b // nheads, 0, i)))
         dkv_inputs.append(kv_mask)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                           block_q=block_q, seq_q=sq, seq_k=sk,
-                          has_mask=has_mask),
-        grid=(bh, sk // block_k),
-        in_specs=dkv_in_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-        ],
+                          has_mask=has_mask, dropout_rate=dropout_rate),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, sk // block_k),
+            in_specs=dkv_in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, i, s: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, s: (b, i, 0)),
+            ]),
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         interpret=interpret,
-    )(*dkv_inputs)
+    )(seed, *dkv_inputs)
     return dq, dk, dv
 
 
@@ -320,46 +403,57 @@ def _flash_backward(res, g, causal, scale, block_q, block_k, interpret,
 # Public entry — [B, S, H, D] layout, custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhsd(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_bhsd(q, k, v, seed, causal, scale, block_q, block_k, interpret,
+                dropout_rate):
     out, _ = _flash_forward(q, k, v, None, causal, scale, block_q, block_k,
-                            interpret)
+                            interpret, dropout_rate=dropout_rate, seed=seed)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, seed, causal, scale, block_q, block_k,
+                    interpret, dropout_rate):
     out, lse = _flash_forward(q, k, v, None, causal, scale, block_q, block_k,
-                              interpret)
-    return out, (q, k, v, None, out, lse)
+                              interpret, dropout_rate=dropout_rate, seed=seed)
+    return out, (q, k, v, None, out, lse, seed)
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
-    return _flash_backward(res, g, causal, scale, block_q, block_k, interpret)
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, dropout_rate,
+                    res, g):
+    dq, dk, dv = _flash_backward(res, g, causal, scale, block_q, block_k,
+                                 interpret, dropout_rate=dropout_rate)
+    import numpy as _np
+    return dq, dk, dv, _np.zeros(res[6].shape, jax.dtypes.float0)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash_bhsd_masked(q, k, v, kv_mask, causal, scale, block_q, block_k,
-                       interpret, nheads):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash_bhsd_masked(q, k, v, kv_mask, seed, causal, scale, block_q,
+                       block_k, interpret, nheads, dropout_rate):
     out, _ = _flash_forward(q, k, v, kv_mask, causal, scale, block_q,
-                            block_k, interpret, nheads)
+                            block_k, interpret, nheads,
+                            dropout_rate=dropout_rate, seed=seed)
     return out
 
 
-def _flash_fwd_rule_masked(q, k, v, kv_mask, causal, scale, block_q, block_k,
-                           interpret, nheads):
+def _flash_fwd_rule_masked(q, k, v, kv_mask, seed, causal, scale, block_q,
+                           block_k, interpret, nheads, dropout_rate):
     out, lse = _flash_forward(q, k, v, kv_mask, causal, scale, block_q,
-                              block_k, interpret, nheads)
-    return out, (q, k, v, kv_mask, out, lse)
+                              block_k, interpret, nheads,
+                              dropout_rate=dropout_rate, seed=seed)
+    return out, (q, k, v, kv_mask, out, lse, seed)
 
 
 def _flash_bwd_rule_masked(causal, scale, block_q, block_k, interpret, nheads,
-                           res, g):
+                           dropout_rate, res, g):
     dq, dk, dv = _flash_backward(res, g, causal, scale, block_q, block_k,
-                                 interpret, nheads)
-    return dq, dk, dv, jnp.zeros_like(res[3])
+                                 interpret, nheads,
+                                 dropout_rate=dropout_rate)
+    import numpy as _np
+    return (dq, dk, dv, jnp.zeros_like(res[3]),
+            _np.zeros(res[6].shape, jax.dtypes.float0))
 
 
 _flash_bhsd_masked.defvjp(_flash_fwd_rule_masked, _flash_bwd_rule_masked)
@@ -371,12 +465,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     softmax_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
+                    dropout_rate: float = 0.0,
+                    dropout_rng: Optional[jax.Array] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Flash attention over [batch, seq, heads, head_dim] tensors.
 
     ``kv_mask``: optional key-padding mask [batch, seq_k], 1/True = attend —
     the fused-kernel answer to the reference's attention-mask input
     (csrc/transformer/softmax_kernels.cu applies it inside attn_softmax).
+
+    ``dropout_rate`` + ``dropout_rng``: in-kernel attention dropout
+    (reference dropout_kernels.cu): the keep-mask is regenerated in the
+    backward kernels from a counter-based hash (see ``dropout_keep_mask``),
+    so no [S, S] mask is ever materialized.
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -398,6 +499,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                          f"({block_q},{block_k})")
     scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
     interpret = _use_interpret() if interpret is None else interpret
+    dropout_rate = float(dropout_rate)
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
+        kd = jax.random.key_data(dropout_rng).astype(jnp.uint32).reshape(-1)
+        seed = (kd[0] ^ (kd[-1] << 1)).astype(jnp.int32)[None]
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
     # [B,S,H,D] -> [B*H, S, D]
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
@@ -407,9 +516,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             raise ValueError(f"kv_mask shape {kv_mask.shape} != {(b, sk)}")
         out = _flash_bhsd_masked(
             to_bhsd(q), to_bhsd(k), to_bhsd(v),
-            kv_mask.astype(jnp.float32)[:, None, :],
-            causal, scale, block_q, block_k, interpret, h)
+            kv_mask.astype(jnp.float32)[:, None, :], seed,
+            causal, scale, block_q, block_k, interpret, h, dropout_rate)
     else:
-        out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v),
-                          causal, scale, block_q, block_k, interpret)
+        out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), seed,
+                          causal, scale, block_q, block_k, interpret,
+                          dropout_rate)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

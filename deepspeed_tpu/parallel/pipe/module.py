@@ -75,16 +75,18 @@ class PipeModel:
                 f"{self.num_blocks} blocks not divisible by pipe={pipe_size}")
 
 
-def gpt_pipe_model(cfg, rng_key=None, example_batch=None) -> PipeModel:
+def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
+                   params=None) -> PipeModel:
     """Build a PipeModel from the in-tree GPT family (models/gpt.py):
     embedding + dropout outside, L GPTBlocks pipelined (attention masks
     travel as aux), ln_f + LM head (tied per cfg.tie_embeddings) +
-    cross-entropy outside."""
+    cross-entropy outside. ``params``: an existing flat GPT param tree
+    (wte/wpe/h_i/ln_f layout) to re-pack instead of fresh-initialising —
+    used when a caller hands pretrained weights to the pipeline or
+    param-offload tiers."""
     import flax.linen as nn
 
-    from deepspeed_tpu.models.gpt import (GPT, GPTBlock,
-                                          cross_entropy_with_ignore,
-                                          shift_labels)
+    from deepspeed_tpu.models.gpt import GPT, GPTBlock, shift_labels
 
     if rng_key is None:
         rng_key = jax.random.PRNGKey(0)
@@ -93,10 +95,13 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None) -> PipeModel:
 
     # Initialise through the reference model so shapes/naming match the
     # non-pipelined family, then re-pack into the PipeModel layout.
-    model = GPT(cfg)
-    variables = model.init({"params": rng_key, "dropout": rng_key},
-                           example_batch)
-    flat = variables["params"]
+    if params is not None:
+        flat = params
+    else:
+        model = GPT(cfg)
+        variables = model.init({"params": rng_key, "dropout": rng_key},
+                               example_batch)
+        flat = variables["params"]
 
     block = GPTBlock(cfg)
     from deepspeed_tpu.parallel.pipe.pipeline import stack_blocks
@@ -142,17 +147,18 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None) -> PipeModel:
     ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32)
 
     def head_fn(params, x, batch):
+        from deepspeed_tpu.ops.xent import fused_cross_entropy
+
         h = ln_f.apply({"params": params["head"]["ln_f"]}, x)
+        labels = shift_labels(batch)
         if cfg.tie_embeddings:
-            logits = jnp.einsum("bsd,vd->bsv", h.astype(cfg.dtype),
-                                params["embed"]["wte"].astype(cfg.dtype),
-                                preferred_element_type=jnp.float32)
-        else:
-            kernel = params["head"]["lm_head"]["kernel"]
-            logits = jnp.einsum("bsd,dv->bsv", h.astype(cfg.dtype),
-                                kernel.astype(cfg.dtype),
-                                preferred_element_type=jnp.float32)
-        return cross_entropy_with_ignore(logits, shift_labels(batch))
+            return fused_cross_entropy(
+                h.astype(cfg.dtype),
+                params["embed"]["wte"].astype(cfg.dtype), labels)
+        kernel = params["head"]["lm_head"]["kernel"]
+        return fused_cross_entropy(h.astype(cfg.dtype),
+                                   kernel.astype(cfg.dtype), labels,
+                                   w_transposed=True)
 
     return PipeModel(embed_fn=embed_fn, block_fn=block_fn,
                      head_fn=head_fn, aux_fn=aux_fn, params=params,

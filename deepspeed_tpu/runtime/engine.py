@@ -179,6 +179,38 @@ class TPUEngine:
                 and not self._offload_cfg.enabled):
             from deepspeed_tpu.runtime.zero.config import ZeroOffloadConfig
             self._offload_cfg = ZeroOffloadConfig(device="cpu")
+        # offload_param — the ZeRO-Infinity param tier (reference
+        # partitioned_param_swapper.py:36, stage3.py:1084): compute-dtype
+        # params live in pinned host memory and the step streams blocks
+        # on-device (runtime/zero/param_offload.py). Requires stage 3 and a
+        # block-structured (PipeModel-derived) streamed loss_fn — built by
+        # deepspeed_tpu.initialize() for in-tree model families.
+        self._offload_param_cfg = config.zero_config.offload_param
+        if self._offload_param_cfg.enabled:
+            if config.zero_config.stage != 3:
+                raise ConfigError(
+                    "offload_param requires ZeRO stage 3 (the param tier is "
+                    "the stage-3 partition, stored in host memory)")
+            if self._offload_param_cfg.device not in ("cpu", "nvme"):
+                raise ConfigError(
+                    f"offload_param.device must be 'cpu' or 'nvme', got "
+                    f"'{self._offload_param_cfg.device}'")
+            if not self._offload_cfg.enabled:
+                # The param tier implies the host optimizer tier: fp32
+                # master + moments live beside the streamed compute params
+                # (reference ZeRO-Infinity couples them the same way —
+                # stage3 offload groups both, stage3.py:1084). With
+                # offload_param.device='nvme' the master/moment tier goes to
+                # disk; the bf16 streaming copy stays in pinned host RAM
+                # (see param_offload.py docstring for the scoping).
+                from deepspeed_tpu.runtime.zero.config import ZeroOffloadConfig
+                self._offload_cfg = ZeroOffloadConfig(
+                    device=self._offload_param_cfg.device,
+                    nvme_path=self._offload_param_cfg.nvme_path,
+                    buffer_count=int(self._offload_param_cfg.buffer_count))
+                log_dist("offload_param: enabling the "
+                         f"{self._offload_param_cfg.device} optimizer tier",
+                         ranks=[0])
 
         # --- initial state placement ---------------------------------------
         self.state = self._init_state(params, rng_seed)
@@ -334,10 +366,13 @@ class TPUEngine:
                                                         to_host)
 
         ocfg = self._offload_cfg
-        if self.config.zero_config.stage == 3:
+        if (self.config.zero_config.stage == 3
+                and not self._offload_param_cfg.enabled):
             raise ValueError(
-                "offload_optimizer with ZeRO stage 3 is not supported; "
-                "use stage <= 2 (the param tier stays on-device via GSPMD)")
+                "offload_optimizer with ZeRO stage 3 requires offload_param "
+                "(the stage-3 param partition must also leave HBM — enable "
+                "zero_optimization.offload_param); with device-resident "
+                "params use stage <= 2")
         mesh = self.mesh
         compute_dtype = (self.precision.dtype if self.precision.mixed
                          else jnp.float32)
@@ -347,15 +382,27 @@ class TPUEngine:
             compute_dtype=compute_dtype,
             aio_threads=int(self.config.aio.thread_count))
 
-        # Device compute params: TP specs if provided, replicated over data.
-        base = self._base_specs if self._base_specs is not None else \
-            jax.tree_util.tree_map(lambda _: PartitionSpec(), params)
-        self._compute_shardings = jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), base)
-        self._compute_params = jax.jit(
-            lambda t: jax.tree_util.tree_map(
-                lambda a: a.astype(compute_dtype), t),
-            out_shardings=self._compute_shardings)(params)
+        if self._offload_param_cfg.enabled:
+            # Param tier: compute-dtype params live in pinned host memory,
+            # ZeRO-3-partitioned over `data`; the (streamed) loss_fn fetches
+            # blocks on-device inside the step. TP base specs are not
+            # composed here — the streamed fetch replicates each block.
+            from deepspeed_tpu.runtime.zero import param_offload as po
+            specs = po.host_storage_specs(params, self.dp_size)
+            self._compute_shardings = po.host_shardings(mesh, specs)
+            self._compute_params = jax.device_put(
+                po.cast_host(params, compute_dtype), self._compute_shardings)
+        else:
+            # Device compute params: TP specs if provided, replicated over
+            # data.
+            base = self._base_specs if self._base_specs is not None else \
+                jax.tree_util.tree_map(lambda _: PartitionSpec(), params)
+            self._compute_shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), base)
+            self._compute_params = jax.jit(
+                lambda t: jax.tree_util.tree_map(
+                    lambda a: a.astype(compute_dtype), t),
+                out_shardings=self._compute_shardings)(params)
 
         cpu_master = self.offloader.master          # None for nvme tier
         cpu_opt = self.offloader.opt_state
@@ -410,6 +457,10 @@ class TPUEngine:
 
             zeros = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), compute_params)
+            # Constrain the accumulator BEFORE the scan too: the carry
+            # buffer itself must be ZeRO-sharded (1/dp per device), not just
+            # the final value.
+            zeros = jax.lax.with_sharding_constraint(zeros, grad_shardings)
             (acc, rng), losses = jax.lax.scan(body, (zeros, rng), batches)
             acc = jax.lax.with_sharding_constraint(acc, grad_shardings)
             overflow = (has_inf_or_nan(acc) if fp16
@@ -425,9 +476,19 @@ class TPUEngine:
 
         self._offload_cast = jax.jit(cast_tree, donate_argnums=(0,))
 
-        def offload_place(tree):
-            placed = jax.device_put(tree, self._compute_shardings)
-            return self._offload_cast(placed)
+        if self._offload_param_cfg.enabled:
+            # Param tier: cast on the host (never a full device copy) and
+            # commit back into pinned host memory.
+            from deepspeed_tpu.runtime.zero import param_offload as po
+            dt = (precision.dtype if precision.mixed else jnp.float32)
+
+            def offload_place(tree):
+                return jax.device_put(po.cast_host(tree, dt),
+                                      self._compute_shardings)
+        else:
+            def offload_place(tree):
+                placed = jax.device_put(tree, self._compute_shardings)
+                return self._offload_cast(placed)
 
         self._offload_place = offload_place
 
@@ -570,9 +631,8 @@ class TPUEngine:
                 scaled = scaled / self.dp_size * cfg.gradient_predivide_factor
             return scaled, (loss32, aux)
 
-        def micro_step(state: TrainState, batch):
+        def micro_step_inner(state: TrainState, batch, compute_params):
             rng, sub = jax.random.split(state.rng)
-            compute_params = precision.cast_params(state.params)
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
             grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
             (_, (loss, aux)), grads = grad_fn(compute_params, batch, sub, scale)
@@ -582,13 +642,22 @@ class TPUEngine:
             return state._replace(micro_step=state.micro_step + 1,
                                   grad_acc=grads, rng=rng), loss, aux
 
+        def micro_step(state: TrainState, batch):
+            return micro_step_inner(state, batch,
+                                    precision.cast_params(state.params))
+
         apply_step = self._make_apply_step()
 
         def train_step(state: TrainState, batches, lr):
-            """Fused GAS loop: batches have leading dim == gas."""
+            """Fused GAS loop: batches have leading dim == gas. The
+            compute-dtype cast of the params is hoisted OUT of the scan —
+            params are loop-invariant until the apply, and re-casting every
+            micro-step costs a full fp32 param read per microbatch (XLA does
+            not reliably hoist large loop-invariant buffers itself)."""
+            compute_params = precision.cast_params(state.params)
 
             def body(st, batch):
-                st, loss, _ = micro_step(st, batch)
+                st, loss, _ = micro_step_inner(st, batch, compute_params)
                 return st, loss
 
             state, losses = jax.lax.scan(body, state, batches)
@@ -642,10 +711,11 @@ class TPUEngine:
                 opt_state=optimizer.state_specs(self.state.params))
 
         def train_step_local(state: TrainState, batches, lr):
+            compute_params = precision.cast_params(state.params)
+
             def body(st, batch):
                 rng, sub = jax.random.split(st.rng)
                 sub = jax.random.fold_in(sub, jax.lax.axis_index(axis))
-                compute_params = precision.cast_params(st.params)
                 scale = st.loss_scale.scale if fp16 else jnp.float32(1.0)
 
                 def scaled(cp):
@@ -880,11 +950,10 @@ class TPUEngine:
     def train_batch(self, batches) -> jax.Array:
         """Fused full step: ``batches`` is a pytree whose leaves have leading
         dim gradient_accumulation_steps (one entry per micro-batch)."""
+        self.tput_timer.start()
+        batches = self.put_batch(self._inject_pld(self._stash_moq_probe(batches)),
+                                 leading_gas_dim=True)
         if self._train_step is None:  # offloaded optimizer tier
-            self.tput_timer.start()
-            batches = self.put_batch(
-                self._inject_pld(self._stash_moq_probe(batches)),
-                leading_gas_dim=True)
             loss = self._offload_train_batch(batches)
             self.global_steps += 1
             self.micro_steps += self.gradient_accumulation_steps
@@ -894,9 +963,6 @@ class TPUEngine:
             self._last_loss = loss
             self._post_step_hooks(loss)
             return loss
-        self.tput_timer.start()
-        batches = self.put_batch(self._inject_pld(self._stash_moq_probe(batches)),
-                                 leading_gas_dim=True)
         lr = self._current_lr()
         self._maybe_profile(self._train_step, self.state, batches, lr,
                             params=self.state.params)

@@ -186,3 +186,131 @@ def test_auto_dispatch_shapes_always_run():
         out = flash_attention(q, q, q, causal=True, interpret=True)
         assert out.shape == q.shape
         assert np.isfinite(np.asarray(out)).all()
+
+
+class TestInKernelDropout:
+    """In-kernel attention dropout (reference dropout_kernels.cu,
+    ds_transformer_cuda.cpp:168-190). The keep-mask comes from a
+    counter-based hash shared between the kernels and this oracle, so
+    parity is exact — fwd AND bwd regenerate the identical mask."""
+
+    RATE = 0.3
+
+    def _qkv(self, rng, b=2, s=256, h=2, d=64):
+        mk = lambda: jnp.asarray(rng.standard_normal((b, s, h, d)),
+                                 jnp.float32)
+        return mk(), mk(), mk()
+
+    def _oracle(self, q, k, v, seed, rate, causal, kv_mask=None):
+        """Dense attention applying the SAME hash-derived keep mask the
+        kernel uses, post-softmax."""
+        from deepspeed_tpu.ops.transformer.flash_attention import \
+            dropout_keep_mask
+
+        b, s, h, d = q.shape
+        sk = k.shape[1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) / (d ** 0.5)
+        neg = jnp.finfo(jnp.float32).min
+        if causal:
+            cm = jnp.tril(jnp.ones((s, sk), jnp.bool_), k=sk - s)
+            logits = jnp.where(cm[None, None], logits, neg)
+        if kv_mask is not None:
+            logits = jnp.where(kv_mask[:, None, None, :].astype(bool),
+                               logits, neg)
+        p = jax.nn.softmax(logits, axis=-1)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (s, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (s, sk), 1)
+        bh = (jnp.arange(b)[:, None] * h + jnp.arange(h)[None, :])
+        keep = jax.vmap(jax.vmap(
+            lambda i: dropout_keep_mask(seed, i, rows, cols, rate)))(bh)
+        p = jnp.where(keep, p / (1.0 - rate), 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    def _flash(self, q, k, v, seed_key, causal, kv_mask=None):
+        return flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
+                               dropout_rate=self.RATE, dropout_rng=seed_key,
+                               interpret=True)
+
+    def _seed_of(self, key):
+        kd = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)
+        return (kd[0] ^ (kd[-1] << 1)).astype(jnp.int32)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_oracle(self, causal):
+        rng = np.random.default_rng(0)
+        q, k, v = self._qkv(rng)
+        key = jax.random.PRNGKey(5)
+        out = self._flash(q, k, v, key, causal)
+        ref = self._oracle(q, k, v, self._seed_of(key), self.RATE, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_forward_with_kv_mask(self):
+        rng = np.random.default_rng(1)
+        q, k, v = self._qkv(rng)
+        mask = np.ones((2, 256), np.int32)
+        mask[:, 200:] = 0
+        mask = jnp.asarray(mask)
+        key = jax.random.PRNGKey(6)
+        out = self._flash(q, k, v, key, False, kv_mask=mask)
+        ref = self._oracle(q, k, v, self._seed_of(key), self.RATE, False,
+                           kv_mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_oracle(self, causal):
+        rng = np.random.default_rng(2)
+        q, k, v = self._qkv(rng)
+        key = jax.random.PRNGKey(7)
+        seed = self._seed_of(key)
+
+        def loss_flash(q, k, v):
+            o = self._flash(q, k, v, key, causal)
+            w = jnp.arange(o.size, dtype=jnp.float32).reshape(o.shape)
+            return jnp.sum(o * w) / o.size
+
+        def loss_ref(q, k, v):
+            o = self._oracle(q, k, v, seed, self.RATE, causal)
+            w = jnp.arange(o.size, dtype=jnp.float32).reshape(o.shape)
+            return jnp.sum(o * w) / o.size
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name}")
+
+    def test_seed_determinism_and_variation(self):
+        rng = np.random.default_rng(3)
+        q, k, v = self._qkv(rng)
+        k1, k2 = jax.random.PRNGKey(1), jax.random.PRNGKey(2)
+        a = self._flash(q, k, v, k1, False)
+        b = self._flash(q, k, v, k1, False)
+        c = self._flash(q, k, v, k2, False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    def test_keep_fraction(self):
+        from deepspeed_tpu.ops.transformer.flash_attention import \
+            dropout_keep_mask
+
+        rows = jax.lax.broadcasted_iota(jnp.int32, (512, 512), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (512, 512), 1)
+        keep = dropout_keep_mask(jnp.int32(123), 3, rows, cols, 0.3)
+        frac = float(jnp.mean(keep.astype(jnp.float32)))
+        assert abs(frac - 0.7) < 0.01, frac
+
+    def test_dispatch_routes_dropout_to_pallas(self):
+        """attention(impl='pallas') with dropout must run the kernel (the
+        round-2 gap: it raised and auto fell back to xla everywhere)."""
+        from deepspeed_tpu.ops.transformer.attention import attention
+
+        rng = np.random.default_rng(4)
+        q, k, v = self._qkv(rng, s=512)
+        out = attention(q, k, v, causal=True, dropout_rate=0.1,
+                        dropout_rng=jax.random.PRNGKey(0),
+                        deterministic=False, impl="pallas")
+        assert np.isfinite(np.asarray(out)).all()

@@ -151,3 +151,64 @@ class TestSparseExecution:
         layout = DenseSparsityConfig(HEADS, BLOCK).make_layout(SEQ)
         with pytest.raises(ValueError, match="layout"):
             sparse_attention(q, k, v, layout, BLOCK)
+
+
+class TestSparseBackward:
+    """Grad parity of the Pallas sparse custom VJP against the xla oracle —
+    the capability the reference's Triton backward modes provide
+    (matmul.py:749 SDD/DSD/DDS, trsrc/softmax_bwd.tr). Round-2 VERDICT
+    task 3."""
+
+    def _qkv(self, rng, seq=SEQ):
+        shape = (2, seq, HEADS, 32)
+        return tuple(jnp.asarray(rng.standard_normal(shape), jnp.float32)
+                     for _ in range(3))
+
+    @pytest.mark.parametrize("cfg", _configs(),
+                             ids=lambda c: type(c).__name__)
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grad_parity(self, cfg, causal):
+        rng = np.random.default_rng(7)
+        q, k, v = self._qkv(rng)
+        layout = cfg.make_layout(SEQ)
+
+        def loss(impl):
+            def f(q, k, v):
+                o = sparse_attention(q, k, v, layout, BLOCK, causal=causal,
+                                     impl=impl, interpret=True)
+                # weighted sum so every output position has a distinct
+                # cotangent (catches transpose-layout mistakes)
+                w = jnp.arange(o.size, dtype=jnp.float32).reshape(o.shape)
+                return jnp.sum(o * w) / o.size
+            return f
+
+        g_ref = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, k, v)
+        g_pal = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("q k v".split(), g_ref, g_pal):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-3, rtol=2e-3,
+                err_msg=f"d{name} mismatch ({type(cfg).__name__})")
+
+    def test_training_step_through_pallas(self):
+        """A toy training step through impl='pallas' must run and reduce
+        loss (the round-2 gap: sparse training was impossible)."""
+        rng = np.random.default_rng(8)
+        q, k, v = self._qkv(rng)
+        layout = FixedSparsityConfig(HEADS, BLOCK,
+                                     num_local_blocks=4).make_layout(SEQ)
+        w = jnp.ones((32, 32)) * 0.1
+        target = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+
+        def loss(w):
+            o = sparse_attention(q @ w, k, v, layout, BLOCK, impl="pallas",
+                                 interpret=True)
+            return jnp.mean((o - target) ** 2)
+
+        grad = jax.jit(jax.grad(loss))
+        losses = []
+        for _ in range(5):
+            g = grad(w)
+            w = w - 0.5 * g
+            losses.append(float(loss(w)))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()

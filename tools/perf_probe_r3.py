@@ -6,8 +6,8 @@ window closed by a scalar fetch. Analytic FLOPs as in bench.py.
 
 Variants isolate where the time goes:
   full        — model loss as shipped (fp32 [B,S,V] logits + fp32 log_softmax)
-  nollhead    — loss = mean(hidden) before the lm head (no head matmul, no CE)
   logitsum    — loss = mean(logits) (head matmul paid, CE skipped)
+  vocab2048   — full with a tiny vocab (head+CE jointly shrunk)
   xla-attn    — full, attention impl forced to xla
   pallas-attn — full, attention impl forced to pallas
 """
