@@ -133,6 +133,7 @@ class MeshConfig:
     pipe: int = 1
     sequence: int = 1
     expert: int = 1
+    slices: int = 1     # DCN-outer slice count (multi-slice/multi-pod)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "MeshConfig":
@@ -143,14 +144,16 @@ class MeshConfig:
             pipe=int(_get(d, C.MESH_PIPE, 1)),
             sequence=int(_get(d, C.MESH_SEQUENCE, 1)),
             expert=int(_get(d, C.MESH_EXPERT, 1)),
+            slices=int(_get(d, C.MESH_SLICES, 1)),
         )
-        for name in ("model", "pipe", "sequence", "expert"):
+        for name in ("model", "pipe", "sequence", "expert", "slices"):
             if getattr(cfg, name) < 1:
                 raise ConfigError(f"mesh.{name} must be >= 1")
         return cfg
 
     def resolve_data(self, world_size: int) -> int:
-        fixed = self.model * self.pipe * self.sequence * self.expert
+        fixed = (self.model * self.pipe * self.sequence * self.expert *
+                 self.slices)
         if world_size % fixed != 0:
             raise ConfigError(
                 f"world size {world_size} not divisible by mesh axes product {fixed}")
@@ -158,8 +161,10 @@ class MeshConfig:
         if self.data not in (-1, data):
             raise ConfigError(
                 f"mesh.data={self.data} inconsistent with world={world_size}, "
-                f"model×pipe×sequence×expert={fixed}")
-        return data
+                f"slices×model×pipe×sequence×expert={fixed}")
+        # The GLOBAL data-parallel degree spans both the ICI-inner `data`
+        # axis and the DCN-outer `dcn` axis (batches shard over both).
+        return data * self.slices
 
 
 @dataclass

@@ -181,6 +181,7 @@ MESH_MODEL = "model"
 MESH_PIPE = "pipe"
 MESH_SEQUENCE = "sequence"
 MESH_EXPERT = "expert"
+MESH_SLICES = "slices"
 
 #############################################
 # Communication / compression

@@ -44,6 +44,15 @@ class GPTConfig:
     remat: bool = False                 # activation checkpointing per block
     tie_embeddings: bool = True
     layer_norm_epsilon: float = 1e-5
+    # MoE-GPT (the GShard/Switch "every other layer is MoE" family): with
+    # moe_experts > 0, every moe_layer_freq-th block's FFN becomes a
+    # deepspeed_tpu.moe.MoE layer (expert-parallel via moe_partition_rules)
+    # and the load-balance aux losses fold into the training loss.
+    moe_experts: int = 0
+    moe_k: int = 1
+    moe_layer_freq: int = 2            # every Nth block is MoE
+    moe_capacity_factor: float = 1.25
+    moe_aux_alpha: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -68,9 +77,14 @@ GPT_CONFIGS: Dict[str, GPTConfig] = {
 
 
 class GPTBlock(nn.Module):
-    """Pre-LN transformer block (attention + MLP)."""
+    """Pre-LN transformer block (attention + MLP or MoE FFN).
+
+    With ``moe=True`` the dense MLP is replaced by a
+    :class:`deepspeed_tpu.moe.MoE` layer and the return value grows a
+    trailing load-balance aux-loss scalar."""
 
     cfg: GPTConfig
+    moe: bool = False
 
     @nn.compact
     def __call__(self, x, attn_mask=None, deterministic: bool = True,
@@ -123,12 +137,25 @@ class GPTBlock(nn.Module):
 
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
                          name="ln_2")(x).astype(dt)
-        h = nn.Dense(cfg.mlp_ratio * d, dtype=dt, name="c_fc")(h)
-        h = nn.gelu(h, approximate=True)
-        h = nn.Dense(d, dtype=dt, name="mlp_proj")(h)
+        aux = None
+        if self.moe:
+            from deepspeed_tpu.moe import MoE, MoEConfig
+
+            h, aux = MoE(MoEConfig(
+                hidden_size=d, num_experts=cfg.moe_experts, k=cfg.moe_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                expert_intermediate=cfg.mlp_ratio * d, dtype=dt),
+                name="moe")(h, deterministic=deterministic)
+        else:
+            h = nn.Dense(cfg.mlp_ratio * d, dtype=dt, name="c_fc")(h)
+            h = nn.gelu(h, approximate=True)
+            h = nn.Dense(d, dtype=dt, name="mlp_proj")(h)
         h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
-        return (x, kv_cache) if kv_cache is not None else x
+        out = (x, kv_cache) if kv_cache is not None else x
+        if self.moe:
+            return (out + (aux,)) if isinstance(out, tuple) else (out, aux)
+        return out
 
 
 class GPT(nn.Module):
@@ -198,18 +225,35 @@ class GPT(nn.Module):
         # the engine injects batch["pld_theta"] when pld.enabled.
         pld_theta = batch.get("pld_theta") if isinstance(batch, dict) else None
         new_cache = []
+        aux_total = jnp.float32(0.0)
+
+        def is_moe(i):
+            return (cfg.moe_experts > 0
+                    and i % cfg.moe_layer_freq == cfg.moe_layer_freq - 1)
+
         for i in range(cfg.num_layers):
             if cache is not None:
-                x, layer_kv = block(cfg, name=f"h_{i}")(
+                out = block(cfg, moe=is_moe(i), name=f"h_{i}")(
                     x, attn_mask, True, cache[i], pos)
+                x, layer_kv = out[0], out[1]   # aux (if any) unused in decode
                 new_cache.append(layer_kv)
             else:
-                y = block(cfg, name=f"h_{i}")(x, attn_mask, deterministic)
+                y = block(cfg, moe=is_moe(i), name=f"h_{i}")(
+                    x, attn_mask, deterministic)
+                aux_i = None
+                if is_moe(i):
+                    y, aux_i = y
                 if pld_theta is not None and not deterministic:
                     p_keep = 1.0 - (i / cfg.num_layers) * (1.0 - pld_theta)
                     gate = jax.random.bernoulli(self.make_rng("dropout"),
                                                 p_keep)
                     y = jnp.where(gate, y, x)
+                    if aux_i is not None:
+                        # a PLD-dropped MoE layer contributed nothing —
+                        # its balance loss must not push its router
+                        aux_i = jnp.where(gate, aux_i, 0.0)
+                if aux_i is not None:
+                    aux_total = aux_total + aux_i
                 x = y
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
@@ -238,6 +282,8 @@ class GPT(nn.Module):
                                        wte.astype(cfg.dtype), labels)
         else:
             loss = cross_entropy_with_ignore(logits, labels)
+        if cfg.moe_experts > 0:
+            loss = loss + cfg.moe_aux_alpha * aux_total
         return {"loss": loss, "logits": logits}
 
 
@@ -284,8 +330,9 @@ def gpt_partition_rules() -> Tuple[Tuple[str, Tuple], ...]:
     the reference's inference TP slicing (module_inject/replace_module.py:11).
     """
     from deepspeed_tpu.models.partition import transformer_block_rules
+    from deepspeed_tpu.moe import moe_partition_rules
 
-    return transformer_block_rules() + (
+    return transformer_block_rules() + moe_partition_rules() + (
         (r".*wpe$", (None, None)),
         (r".*lm_head/kernel$", (None, "model")),
     )

@@ -29,8 +29,16 @@ MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQUENCE_AXIS = "sequence"
 EXPERT_AXIS = "expert"
+# Slice-outer data-parallel axis for multi-slice / multi-pod topologies:
+# collectives over it ride DCN (slow inter-slice links), everything else
+# rides ICI. The reference's analogue is its Ethernet-cluster NCCL/MPI
+# backends (runtime/comm/nccl.py:47) — the 1-bit optimizers compress over
+# exactly this axis, and ZeRO sharding deliberately stays on the ICI-inner
+# `data` axis (SURVEY §2.5 TPU-native row).
+DCN_AXIS = "dcn"
 
-ALL_AXES = (PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, SEQUENCE_AXIS, MODEL_AXIS)
+ALL_AXES = (DCN_AXIS, PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, SEQUENCE_AXIS,
+            MODEL_AXIS)
 
 
 def init_distributed(dist_backend: str = "xla",
@@ -70,6 +78,7 @@ def init_distributed(dist_backend: str = "xla",
 
 @dataclass(frozen=True)
 class MeshShape:
+    dcn: int = 1
     pipe: int = 1
     expert: int = 1
     data: int = 1
@@ -78,10 +87,12 @@ class MeshShape:
 
     @property
     def world(self) -> int:
-        return self.pipe * self.expert * self.data * self.sequence * self.model
+        return (self.dcn * self.pipe * self.expert * self.data *
+                self.sequence * self.model)
 
     def dims(self) -> Dict[str, int]:
-        return {PIPE_AXIS: self.pipe, EXPERT_AXIS: self.expert, DATA_AXIS: self.data,
+        return {DCN_AXIS: self.dcn, PIPE_AXIS: self.pipe,
+                EXPERT_AXIS: self.expert, DATA_AXIS: self.data,
                 SEQUENCE_AXIS: self.sequence, MODEL_AXIS: self.model}
 
 
@@ -90,32 +101,53 @@ def build_mesh(data: int = -1,
                pipe: int = 1,
                sequence: int = 1,
                expert: int = 1,
+               slices: int = 1,
                devices: Optional[Sequence] = None) -> Mesh:
     """Build the framework mesh. ``data=-1`` infers from the device count.
 
-    All five axes are always present (size-1 axes are free); downstream
-    sharding specs can therefore reference any axis unconditionally.
+    All axes are always present (size-1 axes are free); downstream sharding
+    specs can therefore reference any axis unconditionally.
+
+    ``slices > 1`` builds a DCN-aware hierarchical mesh: the outermost
+    ``dcn`` axis spans TPU slices/pods (slow links), every other axis stays
+    inside a slice (ICI). On real multi-slice hardware the device order
+    comes from ``mesh_utils.create_hybrid_device_mesh`` (slice-local
+    ICI topology inside, slice id outside); elsewhere (virtual CPU meshes,
+    single-slice) a plain slice-major reshape stands in.
     """
     devices = list(devices if devices is not None else jax.devices())
     ndev = len(devices)
-    fixed = model * pipe * sequence * expert
+    fixed = model * pipe * sequence * expert * slices
     if data == -1:
         if ndev % fixed != 0:
-            raise ValueError(f"{ndev} devices not divisible by model×pipe×seq×expert={fixed}")
+            raise ValueError(
+                f"{ndev} devices not divisible by "
+                f"slices×model×pipe×seq×expert={fixed}")
         data = ndev // fixed
-    shape = MeshShape(pipe=pipe, expert=expert, data=data, sequence=sequence, model=model)
+    shape = MeshShape(dcn=slices, pipe=pipe, expert=expert, data=data,
+                      sequence=sequence, model=model)
     if shape.world != ndev:
         raise ValueError(f"mesh {shape.dims()} needs {shape.world} devices, have {ndev}")
     dims = shape.dims()
-    # Use hardware-aware device ordering when available so the innermost mesh
-    # axes land on ICI-adjacent chips.
-    try:
-        from jax.experimental import mesh_utils
+    full = tuple(dims[a] for a in ALL_AXES)
+    from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh(
-            tuple(dims[a] for a in ALL_AXES), devices=devices)
-    except Exception:
-        dev_array = np.array(devices).reshape(tuple(dims[a] for a in ALL_AXES))
+    dev_array = None
+    if slices > 1:
+        try:
+            ici = (1,) + full[1:]
+            dcn = (slices,) + (1,) * (len(full) - 1)
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici, dcn, devices=devices)
+        except Exception:
+            dev_array = None    # no slice metadata (CPU / single slice)
+    if dev_array is None:
+        # Use hardware-aware device ordering when available so the
+        # innermost mesh axes land on ICI-adjacent chips.
+        try:
+            dev_array = mesh_utils.create_device_mesh(full, devices=devices)
+        except Exception:
+            dev_array = np.array(devices).reshape(full)
     return Mesh(dev_array, ALL_AXES)
 
 

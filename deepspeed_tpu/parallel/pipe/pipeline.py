@@ -142,8 +142,25 @@ def pipeline_apply(block_fn: Callable,
             inject = jax.lax.dynamic_index_in_dim(
                 x_all, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
             h = jnp.where(rank == 0, inject, buf)
-            # Stage `rank` processes microbatch t - rank at tick t.
-            a = aux_at(aux_all, jnp.clip(t - rank, 0, M - 1))
+            # Stage `rank` processes microbatch m = t - rank at tick t;
+            # fill/drain ticks (m outside [0, M)) carry garbage that no
+            # valid tick ever consumes (producer (r-1, t-1) has the same m
+            # as consumer (r, t)). Executing stage_apply on those ticks
+            # does NOT cost wall-clock — the ppermute keeps ranks in
+            # lockstep and some rank is always active, so the step time is
+            # the critical-path bound T·stage_time either way (proven by
+            # tests/test_pipeline.py::test_step_time_approaches_bubble_
+            # bound); it costs only energy on the (S-1)/(M+S-1) bubble
+            # fraction. A `lax.cond` on the validity predicate would skip
+            # that too and is semantically safe here (garbage flows only
+            # into garbage), and it transposes/remats correctly in minimal
+            # repros — but the full model aborts XLA:CPU at runtime under
+            # this partial-manual shard_map (same backend fragility as the
+            # bf16-psum note below), and with one real TPU chip a
+            # TPU-only branch would ship unexercised. Revisit when the
+            # backend bug is gone.
+            m = t - rank
+            a = aux_at(aux_all, jnp.clip(m, 0, M - 1))
             k = (None if keys is None
                  else jax.random.fold_in(jax.random.fold_in(keys, t), rank))
             y = stage_apply(stage_blocks, h, a, k)

@@ -136,3 +136,138 @@ class TestExpertParallel:
         for _ in range(10):
             last = float(engine.train_batch({"x": x, "t": t}))
         assert last < first
+
+
+class TestScatterDispatch:
+    """Slot-scatter dispatch (round-2 VERDICT weak #4 / task 10b): parity
+    with the GShard einsum oracle, and dispatch memory linear in T (no
+    [T, E, C] intermediate)."""
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_parity_with_einsum(self, k):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((2, 16, 32)), jnp.float32)
+        outs = {}
+        for disp in ("scatter", "einsum"):
+            cfg = MoEConfig(hidden_size=32, num_experts=4, k=k,
+                            capacity_factor=2.0, dtype=jnp.float32,
+                            dispatch=disp)
+            layer = MoE(cfg)
+            params = layer.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+            y, aux = layer.apply({"params": params}, x)
+            outs[disp] = (np.asarray(y), float(aux))
+        np.testing.assert_allclose(outs["scatter"][0], outs["einsum"][0],
+                                   atol=1e-5, rtol=1e-5)
+        assert outs["scatter"][1] == outs["einsum"][1]
+
+    def test_grad_parity_with_einsum(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+        grads = {}
+        for disp in ("scatter", "einsum"):
+            cfg = MoEConfig(hidden_size=16, num_experts=4, k=2,
+                            capacity_factor=2.0, dtype=jnp.float32,
+                            dispatch=disp)
+            layer = MoE(cfg)
+            params = layer.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+
+            def loss(p):
+                y, aux = layer.apply({"params": p}, x)
+                return jnp.mean(y ** 2) + 0.01 * aux
+
+            grads[disp] = jax.grad(loss)(params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4),
+            grads["scatter"], grads["einsum"])
+
+    def test_no_tec_intermediate(self):
+        """The traced scatter path must contain no array of size
+        T*E*C (the one-hot product the einsum path materializes)."""
+        t, e, d = 64, 8, 16
+        # small expert_intermediate so the legitimate [E, C, d_ff] FFN
+        # intermediate stays well below T*E*C
+        cfg = MoEConfig(hidden_size=d, num_experts=e, k=1,
+                        capacity_factor=2.0, dtype=jnp.float32,
+                        dispatch="scatter", expert_intermediate=16)
+        capacity = max(cfg.min_capacity, int(np.ceil(t / e * 2.0)))
+        layer = MoE(cfg)
+        x = jnp.zeros((1, t, d))
+        params = layer.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+        jaxpr = jax.make_jaxpr(
+            lambda p: layer.apply({"params": p}, x)[0])(params)
+
+        def all_avals(jx, out):
+            for eqn in jx.eqns:
+                for v in eqn.outvars:
+                    out.append(v.aval)
+                for val in eqn.params.values():
+                    inner = getattr(val, "jaxpr", None)
+                    if inner is None and type(val).__name__ == "Jaxpr":
+                        inner = val
+                    if inner is not None:
+                        all_avals(inner, out)
+            return out
+
+        tec = t * e * capacity
+        sizes = [int(np.prod(a.shape)) for a in all_avals(jaxpr.jaxpr, [])
+                 if hasattr(a, "shape")]
+        assert not any(s >= tec for s in sizes), sorted(sizes)[-4:]
+
+
+class TestMoEGPT:
+    """MoE wired into the in-tree GPT family (round-2 VERDICT weak #4:
+    'no in-tree model family wires MoE into a full LM')."""
+
+    def _model(self):
+        from deepspeed_tpu.models import make_gpt
+
+        return make_gpt("tiny", vocab_size=256, max_seq_len=64,
+                        hidden_size=32, num_layers=4, num_heads=2,
+                        dropout_rate=0.0, dtype=jnp.float32,
+                        moe_experts=4, moe_k=1, moe_layer_freq=2)
+
+    def test_trains_end_to_end_with_expert_parallelism(self, eight_devices):
+        from deepspeed_tpu.models import build_specs
+        from deepspeed_tpu.models.gpt import gpt_partition_rules
+
+        model, cfg = self._model()
+        mesh = build_mesh(data=4, expert=2)
+        rng = np.random.default_rng(0)
+        batches = {"input_ids": rng.integers(0, 256, (2, 8, 32),
+                                             dtype=np.int32)}
+        one = {"input_ids": batches["input_ids"][0]}
+        params = model.init({"params": jax.random.PRNGKey(0),
+                             "dropout": jax.random.PRNGKey(1)}, one)["params"]
+        # every 2nd block carries experts
+        assert "moe" in params["h_1"] and "moe" in params["h_3"]
+        assert "c_fc" in params["h_0"] and "moe" not in params["h_0"]
+        specs = build_specs(params, gpt_partition_rules(),
+                            mesh_axes=dict(mesh.shape))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, params=params, mesh=mesh,
+            param_partition_specs=specs,
+            config={
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+            })
+        # expert params sharded over the expert axis
+        w = engine.state.params["h_1"]["moe"]["experts_in"]
+        assert w.sharding.shard_shape(w.shape)[0] == w.shape[0] // 2
+        losses = [float(engine.train_batch(batches)) for _ in range(6)]
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    def test_generation_with_moe_blocks(self, eight_devices):
+        """KV-cache decode runs through MoE blocks (aux discarded)."""
+        model, cfg = self._model()
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, (2, 8), dtype=np.int32))
+        variables = model.init({"params": jax.random.PRNGKey(0),
+                                "dropout": jax.random.PRNGKey(1)},
+                               {"input_ids": ids})
+        eng = deepspeed_tpu.init_inference(
+            model, params=variables["params"], dtype=jnp.float32)
+        out = eng.generate(ids, max_new_tokens=4)
+        assert out.shape == (2, 12)

@@ -162,10 +162,13 @@ class TestNvmeOffload:
         with pytest.raises(ValueError, match="nvme_path"):
             build_engine("nvme")
 
-    def test_checkpoint_rejected(self, eight_devices, rng, tmp_path):
+    def test_checkpoint_supported(self, eight_devices, rng, tmp_path):
+        # Round-2 closed the NotImplementedError gap: nvme-tier engines
+        # checkpoint by swapping the tier back in (full round-trip in
+        # TestNvmeCheckpointing).
         e = build_engine("nvme", nvme_path=tmp_path / "swap")
-        with pytest.raises(NotImplementedError):
-            e.save_checkpoint(str(tmp_path / "ck"))
+        path = e.save_checkpoint(str(tmp_path / "ck"))
+        assert path is not None
         e.offloader.close()
 
 
@@ -288,3 +291,59 @@ class TestNativeAio:
         # the per-swapper binding reflects build availability (lazy load)
         from deepspeed_tpu.ops.aio_native import load_aio
         assert (sw._native is None) == (load_aio() is None)
+
+
+class TestNvmeCheckpointing:
+    """NVMe-tier checkpointing (round-2 VERDICT task 9): the swapped
+    (master, moments) state round-trips through save -> restart -> resume.
+    Reference: stage3.py:3250 save_checkpoint_prologue."""
+
+    def test_save_restart_resume(self, eight_devices, tmp_path):
+        rng = np.random.default_rng(0)
+        batches = make_batches(rng, 2, 16, 6)
+        e1 = build_engine("nvme", nvme_path=tmp_path / "swap1")
+        for b in batches[:3]:
+            e1.train_batch(b)
+        path = e1.save_checkpoint(str(tmp_path / "ckpt"), tag="t3")
+        ref_losses = [float(e1.train_batch(b)) for b in batches[3:]]
+        master_after_3 = None  # e1 has advanced; use the checkpoint
+
+        e2 = build_engine("nvme", nvme_path=tmp_path / "swap2")
+        p, client = e2.load_checkpoint(str(tmp_path / "ckpt"), tag="t3")
+        assert p is not None
+        # step counter restored into the leaf-streaming tier (3 steps had
+        # run at save time)
+        assert e2.offloader._step_count == 3
+        # restored TrainState scalars must survive the placeholder revert
+        # (review finding: the finally clause must not clobber them)
+        assert int(e2.state.step) == 3
+        assert int(e2.state.micro_step) == 6
+        # resumed trajectory matches the original run exactly
+        res_losses = [float(e2.train_batch(b)) for b in batches[3:]]
+        np.testing.assert_allclose(res_losses, ref_losses, rtol=1e-5)
+        e1.offloader.close()
+        e2.offloader.close()
+
+    def test_load_without_optimizer_states(self, eight_devices, tmp_path):
+        rng = np.random.default_rng(1)
+        batches = make_batches(rng, 2, 16, 3)
+        e1 = build_engine("nvme", nvme_path=tmp_path / "swapA")
+        for b in batches:
+            e1.train_batch(b)
+        e1.save_checkpoint(str(tmp_path / "ckptA"), tag="t")
+
+        e2 = build_engine("nvme", nvme_path=tmp_path / "swapB")
+        e2.load_checkpoint(str(tmp_path / "ckptA"), tag="t",
+                           load_optimizer_states=False)
+        # master restored...
+        m1 = e1.offloader.export_state()[0]
+        m2 = e2.offloader.export_state()[0]
+        np.testing.assert_allclose(np.asarray(m1["w1"]),
+                                   np.asarray(m2["w1"]), rtol=1e-6)
+        # ...but moments kept fresh (zeros)
+        opt2 = e2.offloader.export_state()[1]
+        assert float(np.abs(np.asarray(opt2.exp_avg["w1"])).max()) == 0.0
+        l = float(e2.train_batch(batches[0]))
+        assert np.isfinite(l)
+        e1.offloader.close()
+        e2.offloader.close()
