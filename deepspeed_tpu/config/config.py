@@ -275,6 +275,18 @@ class DeepSpeedTPUConfig:
         self.gradient_predivide_factor = float(_get(d, C.GRADIENT_PREDIVIDE_FACTOR,
                                                     C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT))
         self.communication_data_type = d.get(C.COMMUNICATION_DATA_TYPE)
+        # data_types.grad_accum_dtype (later-DeepSpeed key): the GAS
+        # accumulator's dtype. The reference's fp16 engine accumulates in
+        # half precision the same way (fp16 flat buffers); fp32 stays the
+        # safe default here.
+        dt_block = d.get("data_types") or {}
+        self.grad_accum_dtype = str(
+            dt_block.get("grad_accum_dtype", "float32"))
+        if self.grad_accum_dtype not in ("float32", "fp32", "bfloat16",
+                                         "bf16"):
+            raise ConfigError(
+                f"data_types.grad_accum_dtype must be float32 or bfloat16, "
+                f"got '{self.grad_accum_dtype}'")
 
         # --- subsystem blocks ------------------------------------------------------
         self.zero_config = ZeroConfig.from_dict(d.get(C.ZERO_OPTIMIZATION))

@@ -78,6 +78,35 @@ class InferenceEngine:
         self.config = cfg
         self.model_cfg = getattr(model, "cfg", None)
 
+        # --- external-model injection (module_inject/replace_policy.py):
+        # a recognized HF-Flax model is converted onto the in-tree family
+        # so it serves through the TPU kernels + TP rules — the
+        # reference's replace_with_kernel_inject for other people's
+        # models (replace_module.py:11). ``injection_policy`` may name a
+        # policy class explicitly; (regex, dims) partition-rule tuples
+        # keep their existing meaning below.
+        inject_pol = None
+        if (isinstance(injection_policy, type)
+                and hasattr(injection_policy, "convert")):
+            inject_pol = injection_policy
+            injection_policy = None
+        if cfg.replace_with_kernel_inject or inject_pol is not None:
+            from deepspeed_tpu.module_inject import convert_external_model
+            if inject_pol is not None or (hasattr(model, "config")
+                                          and self.model_cfg is None):
+                conv = convert_external_model(model, params=params,
+                                              injection_policy=inject_pol,
+                                              dtype=cfg.dtype)
+                if conv is not None:
+                    src_name = type(model).__name__
+                    model, params = conv
+                    self.module = model
+                    self.model_cfg = model.cfg
+                    log_dist(
+                        f"kernel injection: converted {src_name} weights "
+                        f"onto the in-tree {type(model).__name__} family",
+                        ranks=[0])
+
         if checkpoint is not None and params is None:
             from deepspeed_tpu.runtime.checkpointing import load_module_params
             params = load_module_params(checkpoint)

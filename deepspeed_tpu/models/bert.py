@@ -33,6 +33,7 @@ class BertConfig:
     pre_layer_norm: bool = True     # reference fused-kernel default
     remat: bool = False
     layer_norm_epsilon: float = 1e-12
+    fused_ce: bool = True               # ops/xent.py fused CE head
 
     @property
     def head_dim(self) -> int:
@@ -153,7 +154,7 @@ class BertModel(nn.Module):
         out = {"logits": logits}
         loss = jnp.float32(0.0)
         labels = batch.get("labels")
-        if labels is not None:
+        if labels is not None and cfg.fused_ce:
             # Fused CE head (ops/xent.py): avoids the [B,S,V] fp32
             # log-softmax materializations; `logits` above is DCE'd by XLA
             # when the caller uses only the loss.
@@ -161,6 +162,8 @@ class BertModel(nn.Module):
             loss = fused_cross_entropy(h.astype(cfg.dtype),
                                        wte.astype(cfg.dtype), labels,
                                        bias=mlm_bias)
+        elif labels is not None:
+            loss = cross_entropy_with_ignore(logits, labels)
         nsp = batch.get("next_sentence_label")
         if nsp is not None:
             pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,

@@ -44,6 +44,7 @@ class GPTConfig:
     remat: bool = False                 # activation checkpointing per block
     tie_embeddings: bool = True
     layer_norm_epsilon: float = 1e-5
+    fused_ce: bool = True               # ops/xent.py fused CE head
     # MoE-GPT (the GShard/Switch "every other layer is MoE" family): with
     # moe_experts > 0, every moe_layer_freq-th block's FFN becomes a
     # deepspeed_tpu.moe.MoE layer (expert-parallel via moe_partition_rules)
@@ -277,7 +278,7 @@ class GPT(nn.Module):
         # — the fp32-logits einsum and the fused op's compute-dtype one
         # can't CSE; acceptable for eval loops, free for training.)
         labels = shift_labels(batch)
-        if cfg.tie_embeddings:
+        if cfg.tie_embeddings and cfg.fused_ce:
             loss = fused_cross_entropy(x.astype(cfg.dtype),
                                        wte.astype(cfg.dtype), labels)
         else:

@@ -1,0 +1,6 @@
+from deepspeed_tpu.module_inject.replace_policy import (
+    HFBertPolicy, HFGPT2Policy, REPLACE_POLICIES, convert_external_model,
+    policy_for)
+
+__all__ = ["HFGPT2Policy", "HFBertPolicy", "REPLACE_POLICIES",
+           "convert_external_model", "policy_for"]

@@ -1,0 +1,204 @@
+"""External-model injection policies — HF-Flax models onto the TPU kernels.
+
+Reference: ``deepspeed/module_inject/replace_policy.py:43-239`` ships
+per-architecture policies (HFBertLayerPolicy, HFGPT2LayerPolicy, ...) that
+``replace_module.py:11-88`` uses to swap *other people's* nn.Modules for
+DeepSpeed's fused/TP kernel modules in place.
+
+Flax modules are pure functions of a param tree, so "kernel injection" is
+a WEIGHT-LAYOUT conversion instead of module surgery: each policy maps an
+HF-Flax model's param tree onto the in-tree family (``models/gpt.py`` /
+``models/bert.py``), whose forward already routes through the Pallas flash
+kernels, the fused CE head, KV-cache decode and the Megatron TP partition
+rules. ``init_inference(model=<hf flax model>,
+replace_with_kernel_inject=True)`` then serves their weights on our
+engine — the same outcome as the reference's injection, TPU-style.
+
+Numerics note: GPT-2's tanh-approximated gelu matches exactly; HF-BERT's
+exact (erf) gelu differs from our tanh approximation by O(1e-3) per
+activation — parity tests use a correspondingly loose tolerance.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _get(tree, *path):
+    node = tree
+    for p in path:
+        node = node[p]
+    return node
+
+
+def _t(x):
+    return np.asarray(x).T
+
+
+class HFGPT2Policy:
+    """FlaxGPT2Model / FlaxGPT2LMHeadModel → models.gpt.GPT.
+
+    HF's Conv1D-style kernels are stored [out, in] (transposed vs flax
+    Dense); qkv ordering and head reshape match 1:1.
+    """
+
+    model_type = "gpt2"
+
+    @staticmethod
+    def applies(model) -> bool:
+        return getattr(getattr(model, "config", None), "model_type",
+                       None) == "gpt2"
+
+    @staticmethod
+    def convert(hf_params: Dict, hf_config) -> Tuple[Any, Dict]:
+        from deepspeed_tpu.models.gpt import GPT, GPTConfig
+
+        d = int(hf_config.n_embd)
+        inner = int(getattr(hf_config, "n_inner", None) or 4 * d)
+        if inner % d:
+            raise ValueError(f"n_inner={inner} not a multiple of n_embd={d}")
+        cfg = GPTConfig(vocab_size=int(hf_config.vocab_size),
+                        max_seq_len=int(hf_config.n_positions),
+                        hidden_size=d,
+                        num_layers=int(hf_config.n_layer),
+                        num_heads=int(hf_config.n_head),
+                        mlp_ratio=inner // d,
+                        dropout_rate=0.0,
+                        layer_norm_epsilon=float(
+                            hf_config.layer_norm_epsilon),
+                        tie_embeddings=True)
+        tr = hf_params.get("transformer", hf_params)
+        out = {
+            "wte": np.asarray(_get(tr, "wte", "embedding")),
+            "wpe": np.asarray(_get(tr, "wpe", "embedding")),
+            "ln_f": dict(_get(tr, "ln_f")),
+        }
+        for i in range(cfg.num_layers):
+            h = _get(tr, "h", str(i))
+            out[f"h_{i}"] = {
+                "ln_1": dict(h["ln_1"]),
+                "ln_2": dict(h["ln_2"]),
+                "c_attn": {"kernel": _t(h["attn"]["c_attn"]["kernel"]),
+                           "bias": np.asarray(h["attn"]["c_attn"]["bias"])},
+                "c_proj": {"kernel": _t(h["attn"]["c_proj"]["kernel"]),
+                           "bias": np.asarray(h["attn"]["c_proj"]["bias"])},
+                "c_fc": {"kernel": _t(h["mlp"]["c_fc"]["kernel"]),
+                         "bias": np.asarray(h["mlp"]["c_fc"]["bias"])},
+                "mlp_proj": {"kernel": _t(h["mlp"]["c_proj"]["kernel"]),
+                             "bias": np.asarray(h["mlp"]["c_proj"]["bias"])},
+            }
+        return GPT(cfg), out
+
+
+class HFBertPolicy:
+    """FlaxBertModel / FlaxBertForMaskedLM → models.bert.BertModel
+    (post-LN). Separate q/k/v Dense kernels merge into the fused c_attn
+    [D, 3D] — the same q;k;v concatenation the reference's
+    HFBertLayerPolicy feeds its ``attn_qkvw`` (replace_policy.py:43)."""
+
+    model_type = "bert"
+
+    @staticmethod
+    def applies(model) -> bool:
+        return getattr(getattr(model, "config", None), "model_type",
+                       None) == "bert"
+
+    @staticmethod
+    def convert(hf_params: Dict, hf_config) -> Tuple[Any, Dict]:
+        from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+        d = int(hf_config.hidden_size)
+        inner = int(hf_config.intermediate_size)
+        if inner % d:
+            raise ValueError(
+                f"intermediate_size={inner} not a multiple of hidden={d}")
+        cfg = BertConfig(vocab_size=int(hf_config.vocab_size),
+                         max_seq_len=int(hf_config.max_position_embeddings),
+                         hidden_size=d,
+                         num_layers=int(hf_config.num_hidden_layers),
+                         num_heads=int(hf_config.num_attention_heads),
+                         mlp_ratio=inner // d,
+                         type_vocab_size=int(hf_config.type_vocab_size),
+                         dropout_rate=0.0,
+                         layer_norm_epsilon=float(hf_config.layer_norm_eps),
+                         pre_layer_norm=False)
+        bert = hf_params.get("bert", hf_params)
+        emb = bert["embeddings"]
+        out = {
+            "wte": np.asarray(_get(emb, "word_embeddings", "embedding")),
+            "wpe": np.asarray(_get(emb, "position_embeddings", "embedding")),
+            "tte": np.asarray(_get(emb, "token_type_embeddings",
+                                   "embedding")),
+            "ln_emb": dict(emb["LayerNorm"]),
+        }
+        for i in range(cfg.num_layers):
+            lay = _get(bert, "encoder", "layer", str(i))
+            att = lay["attention"]
+            qkv_k = np.concatenate(
+                [np.asarray(att["self"][n]["kernel"])
+                 for n in ("query", "key", "value")], axis=1)
+            qkv_b = np.concatenate(
+                [np.asarray(att["self"][n]["bias"])
+                 for n in ("query", "key", "value")], axis=0)
+            out[f"layer_{i}"] = {
+                "c_attn": {"kernel": qkv_k, "bias": qkv_b},
+                "c_proj": {
+                    "kernel": np.asarray(att["output"]["dense"]["kernel"]),
+                    "bias": np.asarray(att["output"]["dense"]["bias"])},
+                "ln_attn": dict(att["output"]["LayerNorm"]),
+                "c_fc": {
+                    "kernel": np.asarray(
+                        lay["intermediate"]["dense"]["kernel"]),
+                    "bias": np.asarray(lay["intermediate"]["dense"]["bias"])},
+                "mlp_proj": {
+                    "kernel": np.asarray(lay["output"]["dense"]["kernel"]),
+                    "bias": np.asarray(lay["output"]["dense"]["bias"])},
+                "ln_mlp": dict(lay["output"]["LayerNorm"]),
+            }
+        cls = hf_params.get("cls")
+        if cls is not None:  # MaskedLM / PreTraining heads
+            tr = _get(cls, "predictions", "transform")
+            out["mlm_transform"] = {
+                "kernel": np.asarray(tr["dense"]["kernel"]),
+                "bias": np.asarray(tr["dense"]["bias"])}
+            out["mlm_ln"] = dict(tr["LayerNorm"])
+            out["mlm_bias"] = np.asarray(_get(cls, "predictions", "bias"))
+        return BertModel(cfg), out
+
+
+REPLACE_POLICIES = (HFGPT2Policy, HFBertPolicy)
+
+
+def policy_for(model) -> Optional[type]:
+    for pol in REPLACE_POLICIES:
+        if pol.applies(model):
+            return pol
+    return None
+
+
+def convert_external_model(model, params: Any = None,
+                           injection_policy: Optional[type] = None,
+                           dtype: Any = None):
+    """(in-tree module, converted params) for a recognized external model,
+    or None if no policy matches. ``injection_policy`` forces a policy
+    class (the reference's ``injection_policy=`` dict argument); ``dtype``
+    sets the in-tree family's compute dtype (the engine passes its serving
+    dtype so fp32 serving stays fp32 end to end)."""
+    pol = injection_policy or policy_for(model)
+    if pol is None:
+        return None
+    hf_params = params if params is not None else getattr(model, "params",
+                                                          None)
+    if hf_params is None:
+        raise ValueError(
+            f"{type(model).__name__}: pass params= (the HF param dict) — "
+            f"the model instance carries none")
+    # fp32 leaves; the engine casts to its serving dtype.
+    hf_params = jax.tree_util.tree_map(np.asarray, hf_params)
+    module, converted = pol.convert(hf_params, model.config)
+    if dtype is not None:
+        from dataclasses import replace
+
+        module = type(module)(replace(module.cfg, dtype=dtype))
+    return module, converted

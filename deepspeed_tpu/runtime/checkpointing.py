@@ -217,3 +217,22 @@ def consolidate_to_fp32(checkpoint_dir: str, tag: Optional[str] = None) -> Dict[
 
     walk("", params)
     return flat
+
+
+def zero_to_fp32_main():
+    """Console entry ``zero-to-fp32-tpu`` — the reference's standalone
+    ``utils/zero_to_fp32.py`` script: consolidate a (sharded) checkpoint
+    into a flat fp32 ``.npz`` without constructing an engine."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Consolidate a deepspeed_tpu checkpoint to fp32")
+    ap.add_argument("checkpoint_dir")
+    ap.add_argument("output_file", help="destination .npz")
+    ap.add_argument("--tag", default=None)
+    args = ap.parse_args()
+    flat = consolidate_to_fp32(args.checkpoint_dir, tag=args.tag)
+    np.savez(args.output_file, **flat)
+    total = sum(v.size for v in flat.values())
+    print(f"wrote {len(flat)} tensors ({total / 1e6:.1f}M params) "
+          f"to {args.output_file}")

@@ -207,3 +207,44 @@ def test_lr_scheduler_integration(rng):
         engine.backward(None)
         engine.step()
     assert engine.get_lr()[0] == pytest.approx(0.05)
+
+
+class TestGradAccumDtype:
+    def test_bf16_accumulator_tracks_fp32(self, eight_devices):
+        """data_types.grad_accum_dtype=bfloat16 (the reference's fp16-
+        buffer analogue) must track the fp32-accumulator trajectory to
+        bf16 tolerance, with the accumulator actually stored bf16."""
+        import deepspeed_tpu
+
+        def loss_fn(p, b, r):
+            return jnp.mean((jnp.tanh(b["x"] @ p["w"]) - b["y"]) ** 2)
+
+        def build(acc):
+            params = {"w": jax.random.normal(jax.random.PRNGKey(0),
+                                             (16, 8)) * 0.1}
+            e, _, _, _ = deepspeed_tpu.initialize(
+                loss_fn=loss_fn, params=params,
+                config={"train_micro_batch_size_per_gpu": 2,
+                        "gradient_accumulation_steps": 4,
+                        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                        "zero_optimization": {"stage": 2},
+                        "data_types": {"grad_accum_dtype": acc}})
+            return e
+
+        rng = np.random.default_rng(0)
+        b = {"x": rng.standard_normal((4, 16, 16)).astype(np.float32),
+             "y": rng.standard_normal((4, 16, 8)).astype(np.float32)}
+        e32, e16 = build("float32"), build("bfloat16")
+        assert e16.state.grad_acc["w"].dtype == jnp.bfloat16
+        l32 = [float(e32.train_batch(b)) for _ in range(5)]
+        l16 = [float(e16.train_batch(b)) for _ in range(5)]
+        np.testing.assert_allclose(l32, l16, rtol=2e-2)
+
+    def test_rejects_unknown_dtype(self, eight_devices):
+        import deepspeed_tpu
+        from deepspeed_tpu.config.config import ConfigError
+
+        with pytest.raises(ConfigError, match="grad_accum_dtype"):
+            deepspeed_tpu.DeepSpeedTPUConfig(
+                {"train_micro_batch_size_per_gpu": 1,
+                 "data_types": {"grad_accum_dtype": "fp8"}})

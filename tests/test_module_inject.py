@@ -1,0 +1,96 @@
+"""External-model injection policies (round-2 VERDICT task 8).
+
+HF-Flax GPT-2/BERT weights convert onto the in-tree families and serve
+through init_inference — logits parity against the HF forward, and TP=2
+sharded generation matches single-device. Reference:
+module_inject/replace_policy.py:43-239, replace_module.py:11-88.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+transformers = pytest.importorskip("transformers")
+
+
+def tiny_hf_gpt2():
+    from transformers import FlaxGPT2LMHeadModel, GPT2Config
+
+    cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=2, resid_pdrop=0.0, embd_pdrop=0.0,
+                     attn_pdrop=0.0)
+    return FlaxGPT2LMHeadModel(cfg, seed=0)
+
+
+def tiny_hf_bert():
+    from transformers import BertConfig, FlaxBertForMaskedLM
+
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=128,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    return FlaxBertForMaskedLM(cfg, seed=0)
+
+
+class TestGPT2Injection:
+    def test_logits_parity_with_hf(self):
+        hf = tiny_hf_gpt2()
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, 128, (2, 16), dtype=np.int32))
+        hf_logits = np.asarray(hf(ids).logits)
+
+        eng = deepspeed_tpu.init_inference(hf, dtype=jnp.float32)
+        ours = np.asarray(eng.forward({"input_ids": ids})["logits"])
+        np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=2e-4)
+
+    def test_tp2_generation_matches_single_device(self, eight_devices):
+        hf = tiny_hf_gpt2()
+        ids = jnp.asarray(np.random.default_rng(1).integers(
+            0, 128, (2, 8), dtype=np.int32))
+        e1 = deepspeed_tpu.init_inference(hf, dtype=jnp.float32)
+        out1 = np.asarray(e1.generate(ids, max_new_tokens=6))
+        e2 = deepspeed_tpu.init_inference(hf, dtype=jnp.float32, mp_size=2,
+                                          mesh=build_mesh(
+                                              model=2, data=4))
+        out2 = np.asarray(e2.generate(ids, max_new_tokens=6))
+        np.testing.assert_array_equal(out1, out2)
+
+    def test_injection_disabled_requires_intree_contract(self):
+        """replace_with_kernel_inject=False keeps the HF module as-is —
+        our engine can't drive it (no dict-batch contract) and says so."""
+        hf = tiny_hf_gpt2()
+        eng = deepspeed_tpu.init_inference(
+            hf, dtype=jnp.float32, replace_with_kernel_inject=False,
+            params=hf.params)
+        with pytest.raises(Exception):
+            eng.forward({"input_ids": jnp.zeros((1, 8), jnp.int32)})
+
+
+class TestBertInjection:
+    def test_mlm_logits_parity_with_hf(self):
+        hf = tiny_hf_bert()
+        rng = np.random.default_rng(2)
+        ids = jnp.asarray(rng.integers(0, 128, (2, 16), dtype=np.int32))
+        am = jnp.ones((2, 16), jnp.int32)
+        hf_logits = np.asarray(hf(ids, attention_mask=am).logits)
+
+        eng = deepspeed_tpu.init_inference(hf, dtype=jnp.float32)
+        ours = np.asarray(eng.forward(
+            {"input_ids": ids, "attention_mask": am})["logits"])
+        # HF BERT uses exact (erf) gelu; the in-tree family uses the tanh
+        # approximation — O(1e-3) activation differences compound slightly.
+        np.testing.assert_allclose(ours, hf_logits, atol=0.05, rtol=0.05)
+
+    def test_explicit_policy_class(self):
+        from deepspeed_tpu.module_inject import HFBertPolicy
+
+        hf = tiny_hf_bert()
+        eng = deepspeed_tpu.init_inference(hf, dtype=jnp.float32,
+                                           injection_policy=HFBertPolicy)
+        from deepspeed_tpu.models.bert import BertModel
+
+        assert isinstance(eng.module, BertModel)
