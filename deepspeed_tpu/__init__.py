@@ -74,21 +74,29 @@ def initialize(args=None,
         from deepspeed_tpu.runtime.zero.param_offload import \
             build_streamed_loss
 
-        if isinstance(model, PipeModel):
-            pm = model
-            if params is not None:  # e.g. restored weights, pipe layout
-                pm.params = params
-        else:
-            from deepspeed_tpu.models.gpt import GPT
+        import jax as _jax
 
-            if isinstance(model, GPT):
-                pm = gpt_pipe_model(model.cfg, params=params)
+        # Init + pack on the HOST device: the params live in host memory
+        # anyway, and materialising the full fp32 tree (plus the packing
+        # copy) on the accelerator would OOM exactly the models this tier
+        # exists for (a 1.6B GPT already exceeds one v5e's HBM here).
+        with _jax.default_device(_jax.local_devices(backend="cpu")[0]):
+            if isinstance(model, PipeModel):
+                pm = model
             else:
-                raise ValueError(
-                    "offload_param needs a block-structured model: pass a "
-                    "PipeModel (parallel.pipe.module) or an in-tree GPT; "
-                    "opaque modules/loss_fns have no per-block fetch points")
-        loss_fn, params = build_streamed_loss(pm), pm.params
+                from deepspeed_tpu.models.gpt import GPT
+
+                if isinstance(model, GPT):
+                    pm = gpt_pipe_model(model.cfg)
+                else:
+                    raise ValueError(
+                        "offload_param needs a block-structured model: "
+                        "pass a PipeModel (parallel.pipe.module) or an "
+                        "in-tree GPT; opaque modules/loss_fns have no "
+                        "per-block fetch points")
+            # `params` (if given) may be pipe layout OR an already-packed
+            # tree restored from an offload checkpoint.
+            loss_fn, params = build_streamed_loss(pm, params=params)
     if loss_fn is None:
         if model is None:
             raise ValueError("initialize() needs either loss_fn+params or model")

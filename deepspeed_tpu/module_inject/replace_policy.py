@@ -92,9 +92,10 @@ class HFGPT2Policy:
 
 
 class HFBertPolicy:
-    """FlaxBertModel / FlaxBertForMaskedLM → models.bert.BertModel
-    (post-LN). Separate q/k/v Dense kernels merge into the fused c_attn
-    [D, 3D] — the same q;k;v concatenation the reference's
+    """FlaxBertForMaskedLM / FlaxBertForPreTraining → models.bert.BertModel
+    (post-LN; a headless FlaxBertModel is rejected — the in-tree forward
+    needs the MLM head). Separate q/k/v Dense kernels merge into the fused
+    c_attn [D, 3D] — the same q;k;v concatenation the reference's
     HFBertLayerPolicy feeds its ``attn_qkvw`` (replace_policy.py:43)."""
 
     model_type = "bert"
@@ -157,13 +158,17 @@ class HFBertPolicy:
                 "ln_mlp": dict(lay["output"]["LayerNorm"]),
             }
         cls = hf_params.get("cls")
-        if cls is not None:  # MaskedLM / PreTraining heads
-            tr = _get(cls, "predictions", "transform")
-            out["mlm_transform"] = {
-                "kernel": np.asarray(tr["dense"]["kernel"]),
-                "bias": np.asarray(tr["dense"]["bias"])}
-            out["mlm_ln"] = dict(tr["LayerNorm"])
-            out["mlm_bias"] = np.asarray(_get(cls, "predictions", "bias"))
+        if cls is None:
+            raise ValueError(
+                "headless FlaxBertModel has no MLM head ('cls' params) and "
+                "the in-tree BertModel forward requires one — convert a "
+                "FlaxBertForMaskedLM / FlaxBertForPreTraining instead")
+        tr = _get(cls, "predictions", "transform")
+        out["mlm_transform"] = {
+            "kernel": np.asarray(tr["dense"]["kernel"]),
+            "bias": np.asarray(tr["dense"]["bias"])}
+        out["mlm_ln"] = dict(tr["LayerNorm"])
+        out["mlm_bias"] = np.asarray(_get(cls, "predictions", "bias"))
         return BertModel(cfg), out
 
 

@@ -77,7 +77,12 @@ class OptimizerOffloader:
                 lambda p: p.astype(jnp.float32), t))(host)
 
         if self.tier == "cpu":
-            self.opt_state = jax.device_put(optimizer.init(self.master), cpu)
+            # Init the moments ON the host device — jnp.zeros otherwise
+            # materialises the full moment tree on the accelerator first,
+            # which OOMs exactly the models this tier exists for.
+            with jax.default_device(cpu):
+                self.opt_state = jax.device_put(optimizer.init(self.master),
+                                                cpu)
             self._host_step = None  # built lazily (needs lr dtype etc.)
             self.swapper = None
         elif self.tier == "nvme":

@@ -99,16 +99,87 @@ def cast_host(tree: Any, dtype) -> Any:
         else np.asarray(a).astype(npdt), tree)
 
 
-def build_streamed_loss(pipe_model, remat: bool = True):
-    """Loss function over HOST-resident pipe-layout params.
+def pack_blocks(blocks: Any):
+    """Flat-pack the stacked [L, ...] block tree into one [L, P] buffer.
+
+    The streamed copy lives in host memory as ONE contiguous row per block
+    — the analogue of the reference's contiguous fp16 partition buffers
+    (``stage3.py:1084 _create_fp16_partitions_with_defragmentation``), and
+    on TPU it means one H2D DMA per block instead of a dozen small ones.
+    (It also works around an axon-runtime crash when a scan walks a
+    multi-leaf host-memory operand tree with per-iteration fetches.)
+    Returns ``(flat [L, P], meta)`` for :func:`unpack_block`.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(blocks)
+    num = leaves[0].shape[0]
+    shapes = [tuple(l.shape[1:]) for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    dtypes = [l.dtype for l in leaves]
+    flat = jnp.concatenate([jnp.reshape(l, (num, -1)) for l in leaves],
+                           axis=1)
+    # Rows are stored [P/128, 128]: the TPU runtime cannot DMA a 1-D
+    # dynamic-slice row out of pinned host memory inside a scan (hard
+    # runtime fault, found r3), and the sliced row's leading dim must be a
+    # sublane multiple (8) or the compiler faults — so pad P to 8·128.
+    total = flat.shape[1]
+    pad = (-total) % (8 * 128)
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    flat = flat.reshape(num, -1, 128)
+    return flat, (treedef, shapes, sizes, tuple(dtypes))
+
+
+def unpack_block(row: jax.Array, meta) -> Any:
+    """One packed [P/128, 128] row -> the single-block param tree (static
+    slices — fused by XLA, no copies).
+
+    Homogeneous trees (the engine path: everything cast to the compute
+    dtype before packing) keep the row's dtype, so an engine-level cast of
+    the packed buffer is respected. Mixed-dtype trees get each leaf cast
+    back to its pre-pack dtype (concatenate promoted them)."""
+    treedef, shapes, sizes, dtypes = meta
+    homogeneous = len(set(dtypes)) == 1
+    row = row.reshape(-1)
+    out, off = [], 0
+    for s, n, dt in zip(shapes, sizes, dtypes):
+        leaf = row[off:off + n].reshape(s)
+        out.append(leaf if homogeneous else leaf.astype(dt))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def build_streamed_loss(pipe_model, remat: bool = True, params: Any = None):
+    """(loss_fn, host_layout_params) over HOST-resident params.
 
     ``loss_fn(host_params, batch, rng) -> loss`` with per-block device
     fetches: embed + head params are fetched once per microbatch (they feed
-    both ends — weight tying), each block is fetched inside the layer scan
-    right before its compute, and with ``remat`` (default) the backward
-    re-fetches blocks instead of holding every forward copy live.
+    both ends — weight tying), each block's packed row is fetched inside
+    the layer scan right before its compute (one DMA), and with ``remat``
+    (default) the backward re-fetches blocks instead of holding every
+    forward copy live. The returned params tree stores the blocks
+    flat-packed (:func:`pack_blocks`).
+
+    ``params``: optional weights to serve instead of the PipeModel's —
+    either pipe layout (blocks get packed) or an already-packed tree
+    (e.g. restored from an offload checkpoint; used as-is after a shape
+    check — re-packing a packed array would destroy the block structure).
     """
     pm = pipe_model
+    flat, meta = pack_blocks(pm.params["blocks"])
+    if params is None:
+        blocks = flat
+        params = {"embed": pm.params["embed"], "blocks": flat,
+                  "head": pm.params["head"]}
+    else:
+        blocks = params["blocks"]
+        if isinstance(blocks, dict):          # pipe layout: pack it
+            blocks, _ = pack_blocks(blocks)
+        if tuple(blocks.shape) != tuple(flat.shape):
+            raise ValueError(
+                f"provided blocks {tuple(blocks.shape)} do not match the "
+                f"model's packed layout {tuple(flat.shape)}")
+        params = {"embed": params["embed"], "blocks": blocks,
+                  "head": params["head"]}
 
     def loss_fn(host_params, batch, rng):
         persistent = fetch({"embed": host_params["embed"],
@@ -120,21 +191,22 @@ def build_streamed_loss(pipe_model, remat: bool = True):
         x = pm.embed_fn(persistent, batch, r_embed)
         aux = pm.aux_fn(persistent, batch) if pm.aux_fn is not None else None
 
-        def inner(blk_host, x, sub):
-            return pm.block_fn(fetch(blk_host), x, aux, sub)
+        def inner(row_host, x, sub):
+            blk = unpack_block(jax.device_put(row_host, _TO_DEVICE), meta)
+            return pm.block_fn(blk, x, aux, sub)
 
         if remat:
             inner = jax.checkpoint(inner)
 
-        def body(carry, blk_host):
+        def body(carry, row_host):
             x, r = carry
             if r is not None:
                 r, sub = jax.random.split(r)
             else:
                 sub = None
-            return (inner(blk_host, x, sub), r), None
+            return (inner(row_host, x, sub), r), None
 
         (x, rng), _ = jax.lax.scan(body, (x, rng), host_params["blocks"])
         return pm.head_fn(persistent, x, batch)
 
-    return loss_fn
+    return loss_fn, params

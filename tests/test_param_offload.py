@@ -59,10 +59,10 @@ class TestStreamedLossParity:
             rng.integers(0, cfg.vocab_size, (2, 32), dtype=np.int32))}
         plain_loss, flat = flax_module_loss_fn(model, example_batch=batch)
         pm = gpt_pipe_model(cfg, params=flat)
-        streamed = po.build_streamed_loss(pm)
+        streamed, packed = po.build_streamed_loss(pm)
         mesh = deepspeed_tpu.build_mesh(data=8)
-        specs = po.host_storage_specs(pm.params, 8)
-        host_params = po.place_host(pm.params, mesh, specs)
+        specs = po.host_storage_specs(packed, 8)
+        host_params = po.place_host(packed, mesh, specs)
 
         l0, g0 = jax.jit(jax.value_and_grad(
             lambda p: plain_loss(p, batch, None)[0]))(flat)
@@ -73,9 +73,11 @@ class TestStreamedLossParity:
         np.testing.assert_allclose(np.asarray(g0["wte"]),
                                    np.asarray(g1["embed"]["wte"]), rtol=1e-4,
                                    atol=1e-6)
+        _, meta = po.pack_blocks(pm.params["blocks"])
+        g_blk1 = po.unpack_block(g1["blocks"][1], meta)
         np.testing.assert_allclose(
             np.asarray(g0["h_1"]["c_fc"]["kernel"]),
-            np.asarray(g1["blocks"]["c_fc"]["kernel"][1]), rtol=1e-4,
+            np.asarray(g_blk1["c_fc"]["kernel"]), rtol=1e-4,
             atol=1e-6)
 
     def test_dropout_rng_threads_per_layer(self, eight_devices):
@@ -83,9 +85,9 @@ class TestStreamedLossParity:
         split inside the scan) and give a finite loss."""
         model, cfg = make_gpt("tiny", **{**GPT_CFG, "dropout_rate": 0.1})
         pm = gpt_pipe_model(cfg)
-        streamed = po.build_streamed_loss(pm)
+        streamed, packed = po.build_streamed_loss(pm)
         batch = {"input_ids": jnp.zeros((2, 32), jnp.int32)}
-        loss = jax.jit(streamed)(pm.params, batch, jax.random.PRNGKey(0))
+        loss = jax.jit(streamed)(packed, batch, jax.random.PRNGKey(0))
         assert np.isfinite(float(loss))
 
 
