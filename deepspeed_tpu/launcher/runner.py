@@ -292,3 +292,44 @@ def babysit(procs, poll_interval: float = 0.5, term_timeout: float = 10.0,
 
 if __name__ == "__main__":
     main()
+
+
+def ds_ssh_main(argv=None):
+    """``ds-ssh-tpu`` — run a command on every hostfile host (the
+    reference's ``bin/ds_ssh`` pdsh one-liner). Hosts run concurrently;
+    each host's output prints with a ``[host]`` prefix once that host
+    finishes; exits non-zero if any host fails."""
+    import subprocess
+
+    parser = argparse.ArgumentParser(
+        description="Run a command on all hosts of a hostfile")
+    parser.add_argument("-H", "--hostfile", default="/job/hostfile")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run on every host")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        parser.error(f"hostfile {args.hostfile} missing or empty")
+    cmd = " ".join(shlex.quote(c) for c in args.command)
+    procs = []
+    for host in resources:
+        if host in ("localhost", "127.0.0.1"):
+            p = subprocess.Popen(["/bin/sh", "-c", cmd],
+                                 stdin=subprocess.DEVNULL,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+        else:
+            p = subprocess.Popen(
+                ["ssh", "-n", "-o", "StrictHostKeyChecking=no", host, cmd],
+                stdin=subprocess.DEVNULL,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        procs.append((host, p))
+    rc = 0
+    for host, p in procs:
+        out, _ = p.communicate()
+        for line in (out or "").splitlines():
+            print(f"[{host}] {line}")
+        rc = rc or p.returncode
+    return rc

@@ -176,3 +176,25 @@ class TestBabysit:
                 if p.poll() is None:
                     p.kill()
                 p.wait()
+
+
+class TestDsSsh:
+    def test_runs_command_on_hostfile_hosts(self, tmp_path, capsys):
+        """ds-ssh-tpu (reference bin/ds_ssh): localhost entries run
+        locally so the fan-out is testable without sshd."""
+        from deepspeed_tpu.launcher.runner import ds_ssh_main
+
+        hf = tmp_path / "hostfile"
+        hf.write_text("localhost slots=4\n")
+        rc = ds_ssh_main(["-H", str(hf), "echo", "hello-from-ds-ssh"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[localhost] hello-from-ds-ssh" in out
+
+    def test_nonzero_exit_propagates(self, tmp_path):
+        from deepspeed_tpu.launcher.runner import ds_ssh_main
+
+        hf = tmp_path / "hostfile"
+        hf.write_text("localhost slots=4\n")
+        rc = ds_ssh_main(["-H", str(hf), "false"])
+        assert rc != 0
