@@ -290,17 +290,12 @@ def babysit(procs, poll_interval: float = 0.5, term_timeout: float = 10.0,
     return rc
 
 
-if __name__ == "__main__":
-    main()
-
 
 def ds_ssh_main(argv=None):
     """``ds-ssh-tpu`` — run a command on every hostfile host (the
     reference's ``bin/ds_ssh`` pdsh one-liner). Hosts run concurrently;
     each host's output prints with a ``[host]`` prefix once that host
     finishes; exits non-zero if any host fails."""
-    import subprocess
-
     parser = argparse.ArgumentParser(
         description="Run a command on all hosts of a hostfile")
     parser.add_argument("-H", "--hostfile", default="/job/hostfile")
@@ -322,7 +317,7 @@ def ds_ssh_main(argv=None):
                                  stderr=subprocess.STDOUT, text=True)
         else:
             p = subprocess.Popen(
-                ["ssh", "-n", "-o", "StrictHostKeyChecking=no", host, cmd],
+                ["ssh", "-n", host, cmd],
                 stdin=subprocess.DEVNULL,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         procs.append((host, p))
@@ -333,3 +328,6 @@ def ds_ssh_main(argv=None):
             print(f"[{host}] {line}")
         rc = rc or p.returncode
     return rc
+
+if __name__ == "__main__":
+    main()

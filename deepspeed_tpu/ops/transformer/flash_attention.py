@@ -50,6 +50,17 @@ def _use_interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
+def fit_block(block: int, seq: int) -> int:
+    """Largest 128-multiple <= `block` that divides `seq` (the kernels
+    require whole blocks); used by the auto-dispatch gate too — degraded
+    blocks lose to XLA (see attention.py crossover notes)."""
+    block = min(block, seq)
+    if seq % 128 == 0:
+        while seq % block:
+            block -= 128
+    return block
+
+
 # ---------------------------------------------------------------------------
 # Attention dropout — counter-based hash PRNG
 # ---------------------------------------------------------------------------
@@ -490,18 +501,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     b, sq, h, d = q.shape
     sk = k.shape[1]
 
-    def fit(block, seq):
-        # Largest 128-multiple <= requested that divides seq (the kernels
-        # require whole blocks); non-128-multiple seqs keep the clamp and
-        # hit the explicit divisibility error below.
-        block = min(block, seq)
-        if seq % 128 == 0:
-            while seq % block:
-                block -= 128
-        return block
-
-    block_q = fit(block_q, sq)
-    block_k = fit(block_k, sk)
+    block_q = fit_block(block_q, sq)
+    block_k = fit_block(block_k, sk)
     if sq % block_q or sk % block_k:
         raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks "
                          f"({block_q},{block_k})")

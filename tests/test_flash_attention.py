@@ -314,3 +314,22 @@ class TestInKernelDropout:
                         dropout_rng=jax.random.PRNGKey(0),
                         deterministic=False, impl="pallas")
         assert np.isfinite(np.asarray(out)).all()
+
+
+class TestDispatchBlockQuality:
+    def test_gate_rejects_degraded_block_seqs(self):
+        """Sequences whose largest 128-multiple divisor is small (640, 896)
+        must NOT pass the auto-dispatch predicate — degraded blocks lose
+        to XLA (measured r3: 640 pallas 22.9 vs xla 15.3 ms). With
+        attention dropout active the refinement flips (xla pays bernoulli
+        + an [S,S] mask, measured ~2x)."""
+        from deepspeed_tpu.ops.transformer import attention as att
+
+        q = jnp.zeros((2, 640, 4, 64), jnp.bfloat16)
+        assert not att._pallas_ok(q, q, None, None)
+        assert att._pallas_ok(q, q, None, None, dropout_active=True)
+        q = jnp.zeros((2, 896, 4, 64), jnp.bfloat16)
+        assert not att._pallas_ok(q, q, None, None)
+        for s in (512, 1024, 1536, 2048):
+            q = jnp.zeros((2, s, 4, 64), jnp.bfloat16)
+            assert att._pallas_ok(q, q, None, None), s
