@@ -317,7 +317,8 @@ def ds_ssh_main(argv=None):
                                  stderr=subprocess.STDOUT, text=True)
         else:
             p = subprocess.Popen(
-                ["ssh", "-n", host, cmd],
+                ["ssh", "-n", "-o", "StrictHostKeyChecking=accept-new",
+                 host, cmd],
                 stdin=subprocess.DEVNULL,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         procs.append((host, p))

@@ -127,19 +127,20 @@ def _pallas_ok(q, k, bias, mask, dropout_active: bool = False):
         # mask and roughly doubles (crossover table above): pallas wins
         # even on degraded blocks, so skip the block-quality refinement.
         return True
+    if sq != sk:
+        # Cross-attention (short queries over a long key cache): the
+        # block-quality measurements below are self-attention-only, and
+        # the O(S) memory advantage dominates — keep the flash path.
+        return True
     # Self-attention lengths whose only 128-multiple divisors are small
     # (640, 768, 896, 1152, ...) collapse the Q blocks and XLA wins there
     # — measured r3 fwd+bwd 8-layer stacks: seq 640 pallas 22.9 vs xla
     # 15.3 ms; 768: 25.7 vs 18.4; 896: 30.7 vs 20.7; 1152: 27.1 vs 23.7.
     # Require the full 512-wide blocks the crossover table was tuned with.
-    # (K side: fit_block(1024, sk) returns sk itself for 512 < sk <= 1024 —
-    # ONE large kv block, not a degraded one — so only genuinely small
-    # fits are rejected. Explicit impl="pallas" still overrides.)
     from deepspeed_tpu.ops.transformer.flash_attention import (
-        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, fit_block)
+        DEFAULT_BLOCK_Q, fit_block)
 
-    return (fit_block(DEFAULT_BLOCK_Q, sq) >= 512
-            and fit_block(DEFAULT_BLOCK_K, sk) >= 512)
+    return fit_block(DEFAULT_BLOCK_Q, sq) >= 512
 
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -153,8 +154,8 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
               mesh=None,
               impl: str = "auto") -> jax.Array:
     """Dispatching attention entry point used by every model family."""
+    dropout_active = dropout_rate > 0.0 and not deterministic
     if impl == "auto":
-        dropout_active = dropout_rate > 0.0 and not deterministic
         impl = ("pallas" if _on_tpu() and _pallas_ok(
             q, k, bias, mask, dropout_active) else "xla")
     if impl == "pallas":
@@ -166,8 +167,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                              "sparse attention for layout masks)")
         from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
 
-        rate = dropout_rate if (dropout_rate > 0.0 and not deterministic) \
-            else 0.0
+        rate = dropout_rate if dropout_active else 0.0
         return flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
                                softmax_scale=softmax_scale,
                                dropout_rate=rate, dropout_rng=dropout_rng)
