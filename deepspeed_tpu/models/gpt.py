@@ -45,6 +45,8 @@ class GPTConfig:
     tie_embeddings: bool = True
     layer_norm_epsilon: float = 1e-5
     fused_ce: bool = True               # ops/xent.py fused CE head
+    # None -> 1/sqrt(head_dim); GPT-Neo trains UNSCALED attention (1.0)
+    attention_scale: Any = None
     # MoE-GPT (the GShard/Switch "every other layer is MoE" family): with
     # moe_experts > 0, every moe_layer_freq-th block's FFN becomes a
     # deepspeed_tpu.moe.MoE layer (expert-parallel via moe_partition_rules)
@@ -126,11 +128,13 @@ class GPTBlock(nn.Module):
             if attn_mask is not None:
                 dec_mask = jnp.logical_and(dec_mask, attn_mask)
             o = attention(q, ck, cv, causal=False, mask=dec_mask,
-                          deterministic=True, impl="xla")
+                          deterministic=True, impl="xla",
+                          softmax_scale=cfg.attention_scale)
         else:
             o = attention(q, k, v, causal=True, mask=attn_mask,
                           dropout_rate=cfg.dropout_rate, dropout_rng=drop_rng,
-                          deterministic=deterministic, impl=cfg.attention_impl)
+                          deterministic=deterministic, impl=cfg.attention_impl,
+                          softmax_scale=cfg.attention_scale)
         o = o.reshape(b, s, d)
         o = nn.Dense(d, dtype=dt, name="c_proj")(o)
         o = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(o)

@@ -1,6 +1,7 @@
 from deepspeed_tpu.module_inject.replace_policy import (
-    HFBertPolicy, HFGPT2Policy, REPLACE_POLICIES, convert_external_model,
-    policy_for)
+    HFBertPolicy, HFGPT2Policy, HFGPTNeoPolicy, REPLACE_POLICIES,
+    convert_external_model, policy_for)
 
-__all__ = ["HFGPT2Policy", "HFBertPolicy", "REPLACE_POLICIES",
+__all__ = ["HFGPT2Policy", "HFBertPolicy", "HFGPTNeoPolicy",
+           "REPLACE_POLICIES",
            "convert_external_model", "policy_for"]

@@ -172,7 +172,6 @@ class HFBertPolicy:
         return BertModel(cfg), out
 
 
-REPLACE_POLICIES = (HFGPT2Policy, HFBertPolicy)
 
 
 def policy_for(model) -> Optional[type]:
@@ -207,3 +206,97 @@ def convert_external_model(model, params: Any = None,
 
         module = type(module)(replace(module.cfg, dtype=dtype))
     return module, converted
+
+
+class HFGPTNeoPolicy:
+    """FlaxGPTNeoForCausalLM / FlaxGPTNeoModel → models.gpt.GPT (the
+    reference's HFGPTNEOLayerPolicy, replace_policy.py:102).
+
+    GPT-Neo particulars honored: plain-Dense [in, out] kernels (no Conv1D
+    transpose), bias-free q/k/v merged into c_attn with a zero bias,
+    UNSCALED attention scores (attention_scale=1.0), tied lm_head. Local
+    (windowed) attention layers are exact only while the sequence fits the
+    window, so the converted model's max_seq_len is clamped to
+    ``min(max_position_embeddings, window_size)`` when any layer is local
+    — within that range local and global causal attention coincide.
+    """
+
+    model_type = "gpt_neo"
+
+    @staticmethod
+    def applies(model) -> bool:
+        return getattr(getattr(model, "config", None), "model_type",
+                       None) == "gpt_neo"
+
+    @staticmethod
+    def convert(hf_params: Dict, hf_config) -> Tuple[Any, Dict]:
+        from deepspeed_tpu.models.gpt import GPT, GPTConfig
+        from deepspeed_tpu.utils.logging import logger
+
+        d = int(hf_config.hidden_size)
+        inner = int(getattr(hf_config, "intermediate_size", None) or 4 * d)
+        if inner % d:
+            raise ValueError(
+                f"intermediate_size={inner} not a multiple of hidden={d}")
+        if not getattr(hf_config, "tie_word_embeddings", True):
+            raise ValueError(
+                "GPT-Neo with tie_word_embeddings=False has a separate "
+                "lm_head the in-tree tied GPT cannot represent — untied "
+                "conversion is not supported")
+        act = getattr(hf_config, "activation_function", "gelu_new")
+        if act not in ("gelu_new", "gelu"):
+            raise ValueError(
+                f"GPT-Neo activation_function='{act}' is not the gelu the "
+                f"in-tree GPT computes — conversion would be silently wrong")
+        max_pos = int(hf_config.max_position_embeddings)
+        attn_types = [t for block in hf_config.attention_types
+                      for t in block[0] * block[1]]
+        if "local" in attn_types and int(hf_config.window_size) < max_pos:
+            max_pos = int(hf_config.window_size)
+            logger.warning(
+                f"GPT-Neo has local-attention layers (window "
+                f"{max_pos}): the converted model's context is clamped "
+                f"from {hf_config.max_position_embeddings} to {max_pos} "
+                f"tokens, within which local and global causal attention "
+                f"coincide exactly; longer prompts need a banded-mask "
+                f"forward (not yet wired)")
+        cfg = GPTConfig(vocab_size=int(hf_config.vocab_size),
+                        max_seq_len=max_pos,
+                        hidden_size=d,
+                        num_layers=int(hf_config.num_layers),
+                        num_heads=int(hf_config.num_heads),
+                        mlp_ratio=inner // d,
+                        dropout_rate=0.0,
+                        layer_norm_epsilon=float(
+                            hf_config.layer_norm_epsilon),
+                        tie_embeddings=True,
+                        attention_scale=1.0)
+        tr = hf_params.get("transformer", hf_params)
+        out = {
+            "wte": np.asarray(_get(tr, "wte", "embedding")),
+            "wpe": np.asarray(_get(tr, "wpe", "embedding"))[:max_pos],
+            "ln_f": dict(_get(tr, "ln_f")),
+        }
+        for i in range(cfg.num_layers):
+            h = _get(tr, "h", str(i))
+            att = h["attn"]["attention"]
+            qkv_k = np.concatenate(
+                [np.asarray(att[n]["kernel"])
+                 for n in ("q_proj", "k_proj", "v_proj")], axis=1)
+            out[f"h_{i}"] = {
+                "ln_1": dict(h["ln_1"]),
+                "ln_2": dict(h["ln_2"]),
+                "c_attn": {"kernel": qkv_k,
+                           "bias": np.zeros((3 * d,), np.float32)},
+                "c_proj": {"kernel": np.asarray(att["out_proj"]["kernel"]),
+                           "bias": np.asarray(att["out_proj"]["bias"])},
+                "c_fc": {"kernel": np.asarray(h["mlp"]["c_fc"]["kernel"]),
+                         "bias": np.asarray(h["mlp"]["c_fc"]["bias"])},
+                "mlp_proj": {
+                    "kernel": np.asarray(h["mlp"]["c_proj"]["kernel"]),
+                    "bias": np.asarray(h["mlp"]["c_proj"]["bias"])},
+            }
+        return GPT(cfg), out
+
+
+REPLACE_POLICIES = (HFGPT2Policy, HFBertPolicy, HFGPTNeoPolicy)

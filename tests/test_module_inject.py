@@ -94,3 +94,34 @@ class TestBertInjection:
         from deepspeed_tpu.models.bert import BertModel
 
         assert isinstance(eng.module, BertModel)
+
+
+class TestGPTNeoInjection:
+    def _tiny(self, window=64):
+        from transformers import FlaxGPTNeoForCausalLM, GPTNeoConfig
+
+        cfg = GPTNeoConfig(vocab_size=128, max_position_embeddings=64,
+                           hidden_size=32, num_layers=2, num_heads=2,
+                           attention_types=[[["global", "local"], 1]],
+                           window_size=window, resid_dropout=0.0,
+                           embed_dropout=0.0, attention_dropout=0.0)
+        return FlaxGPTNeoForCausalLM(cfg, seed=0)
+
+    def test_logits_parity_with_hf(self):
+        """GPT-Neo converts exactly (unscaled attention, Dense layouts)
+        while the sequence fits the local window."""
+        hf = self._tiny()
+        ids = jnp.asarray(np.random.default_rng(3).integers(
+            0, 128, (2, 16), dtype=np.int32))
+        hf_logits = np.asarray(hf(ids).logits)
+        eng = deepspeed_tpu.init_inference(hf, dtype=jnp.float32)
+        assert eng.module.cfg.attention_scale == 1.0
+        ours = np.asarray(eng.forward({"input_ids": ids})["logits"])
+        np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=2e-4)
+
+    def test_window_clamps_max_seq(self):
+        hf = self._tiny(window=32)
+        eng = deepspeed_tpu.init_inference(hf, dtype=jnp.float32)
+        assert eng.module.cfg.max_seq_len == 32
+        out = eng.generate(jnp.zeros((1, 8), jnp.int32), max_new_tokens=4)
+        assert out.shape == (1, 12)
