@@ -34,6 +34,9 @@ class BertConfig:
     remat: bool = False
     layer_norm_epsilon: float = 1e-12
     fused_ce: bool = True               # ops/xent.py fused CE head
+    # exact fp32-logits numerics inside the fused CE (parity-sensitive
+    # bf16 runs; costs the fp32 [N,V] HBM pass the fused op avoids)
+    fused_ce_fp32_logits: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -161,7 +164,8 @@ class BertModel(nn.Module):
             from deepspeed_tpu.ops.xent import fused_cross_entropy
             loss = fused_cross_entropy(h.astype(cfg.dtype),
                                        wte.astype(cfg.dtype), labels,
-                                       bias=mlm_bias)
+                                       bias=mlm_bias,
+                                       logits_fp32=cfg.fused_ce_fp32_logits)
         elif labels is not None:
             loss = cross_entropy_with_ignore(logits, labels)
         nsp = batch.get("next_sentence_label")

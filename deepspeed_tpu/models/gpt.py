@@ -45,6 +45,9 @@ class GPTConfig:
     tie_embeddings: bool = True
     layer_norm_epsilon: float = 1e-5
     fused_ce: bool = True               # ops/xent.py fused CE head
+    # exact fp32-logits numerics inside the fused CE (parity-sensitive
+    # bf16 runs; costs the fp32 [N,V] HBM pass the fused op avoids)
+    fused_ce_fp32_logits: bool = False
     # None -> 1/sqrt(head_dim); GPT-Neo trains UNSCALED attention (1.0)
     attention_scale: Any = None
     # MoE-GPT (the GShard/Switch "every other layer is MoE" family): with
@@ -284,7 +287,8 @@ class GPT(nn.Module):
         labels = shift_labels(batch)
         if cfg.tie_embeddings and cfg.fused_ce:
             loss = fused_cross_entropy(x.astype(cfg.dtype),
-                                       wte.astype(cfg.dtype), labels)
+                                       wte.astype(cfg.dtype), labels,
+                                       logits_fp32=cfg.fused_ce_fp32_logits)
         else:
             loss = cross_entropy_with_ignore(logits, labels)
         if cfg.moe_experts > 0:

@@ -8,148 +8,96 @@ Two phases, as in the reference:
   *momentum* is synchronised with the error-compensated 1-bit collective
   (comm/compressed.py) instead of any dense gradient allreduce.
 
-Engine contract: this optimizer sets ``needs_local_grads = True`` — the
-engine then runs the whole update inside a shard_map manual over ``data``
-and hands it this rank's LOCAL (unreduced) gradients; during warmup the
-optimizer densely ``pmean``s them itself. Params/moments are replicated
-across data (ZeRO-0; the reference similarly bypasses ZeRO here).
+Engine contract: ``needs_local_grads = True`` — the engine runs
+``sync_phase`` inside a shard_map manual over the compression axis (plus
+``pipe`` under the PipelineEngine) on this rank's LOCAL (unreduced)
+gradients, then ``finish_step`` in GSPMD-auto mode where ZeRO-0/1 optimizer
+-state placement composes (see ops/onebit/common.py for the design). The
+reference similarly picks its comm path per engine flavor
+(onebit/adam.py:92-104).
 
-State layout: moments per param; error feedback buffers per param in a
-flat, 8·n-aligned representation.
+State layout: moments per param (placed by the engine's ZeRO ``opt_specs``);
+error-feedback buffers per param in a flat, 8·n-aligned, shard-aware
+representation (common.py).
 """
 
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+from jax.sharding import PartitionSpec
 
-from deepspeed_tpu.comm.compressed import sync_momentum_compressed
-from deepspeed_tpu.parallel.mesh import DATA_AXIS
+from deepspeed_tpu.ops.onebit.common import OneBitBase, _pad_len  # noqa: F401 (_pad_len re-exported for lamb/tests)
 
 
 class OneBitState(NamedTuple):
     step: jax.Array
     m: Any              # first moment (per-param tree)
     v: Any              # second moment (frozen after warmup)
-    worker_error: Any   # flat error-feedback per param [padded numel]
-    server_error: Any   # flat server error per param [padded numel / n]
+    worker_error: Any   # flat error-feedback per param [n, S·pad]
+    server_error: Any   # flat server error per param [n, S·pad / n]
 
 
-def _pad_len(numel: int, n: int) -> int:
-    align = 8 * n
-    return (numel + align - 1) // align * align
-
-
-class OneBitAdam:
-    """Functional optimizer. ``update`` must run inside a data-manual
-    shard_map (the engine arranges this when ``needs_local_grads``)."""
-
-    needs_local_grads = True
-
-    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
-                 weight_decay: float = 0.0, freeze_step: int = 100,
-                 mesh=None, axis: str = DATA_AXIS, comm_size: int = None,
-                 **_ignored):
-        self.lr = float(lr)
-        self.b1, self.b2 = float(betas[0]), float(betas[1])
-        self.eps = float(eps)
-        self.weight_decay = float(weight_decay)
-        self.freeze_step = int(freeze_step)
-        self.axis = axis
-        self.n = int(comm_size if comm_size is not None
-                     else (mesh.shape.get(axis, 1) if mesh is not None else 1))
+class OneBitAdam(OneBitBase):
+    """Functional optimizer. ``sync_phase`` must run inside a manual
+    shard_map (the engine arranges this when ``needs_local_grads``);
+    ``finish_step``/``update`` are elementwise."""
 
     def init(self, params):
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
-        m = jax.tree_util.tree_map(zeros, params)
-        v = jax.tree_util.tree_map(zeros, params)
-        # Error buffers are PER-RANK state: stored [n, ...] with the leading
-        # dim sharded over data so each rank keeps its own slice across steps.
-        we = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(
-                (self.n, _pad_len(int(np.prod(p.shape) or 1), self.n)),
-                jnp.float32), params)
-        se = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(
-                (self.n, _pad_len(int(np.prod(p.shape) or 1), self.n)
-                 // self.n), jnp.float32), params)
-        return OneBitState(step=jnp.zeros((), jnp.int32), m=m, v=v,
+        we, se = self._init_error_buffers(params)
+        return OneBitState(step=jnp.zeros((), jnp.int32),
+                           m=jax.tree_util.tree_map(zeros, params),
+                           v=jax.tree_util.tree_map(zeros, params),
                            worker_error=we, server_error=se)
 
-    def state_specs(self, params):
-        """Placement: moments replicated, error buffers sharded over data
-        (consumed by the engine's local-grad shard_map path)."""
-        from jax.sharding import PartitionSpec as P
-
-        rep = jax.tree_util.tree_map(lambda _: P(), params)
-        shard0 = jax.tree_util.tree_map(lambda _: P(self.axis), params)
-        return OneBitState(step=P(), m=rep, v=rep,
-                           worker_error=shard0, server_error=shard0)
+    def state_specs(self, params, opt_specs=None):
+        """Placement: moments follow the engine's ZeRO opt-state specs
+        (replicated at stage 0, data-sharded at stage 1, pipe-composed under
+        the PipelineEngine); error buffers shard over (compression axis,
+        pipe)."""
+        rep = jax.tree_util.tree_map(lambda _: PartitionSpec(), params)
+        mv = opt_specs if opt_specs is not None else rep
+        we_s, se_s = self._error_specs(params)
+        return OneBitState(step=PartitionSpec(), m=mv, v=mv,
+                           worker_error=we_s, server_error=se_s)
 
     # ------------------------------------------------------------------
-    def update(self, grads, state: OneBitState, params, lr=None):
-        """grads are LOCAL (per-rank); runs inside data-manual shard_map."""
+    def finish_step(self, params, state: OneBitState, m_new, g_dense,
+                    we_new, se_new, lr=None):
+        """GSPMD-auto phase: variance update (warmup only) + bias-corrected
+        Adam apply. ``m_new``/``g_dense`` come from ``sync_phase``."""
         lr = self.lr if lr is None else lr
         step = state.step + 1
         t = step.astype(jnp.float32)
         warm = step <= self.freeze_step
+        bc1 = 1 - self.b1 ** t
+        bc2 = 1 - self.b2 ** t
 
-        def leaf(p, g, m, v, we, se):
-            g = g.astype(jnp.float32)
-            we2d, se2d = we.ndim == 2, se.ndim == 2
-            if we2d:
-                we = we[0]
-            if se2d:
-                se = se[0]
-            if self.n > 1:
-                # Phases gated with lax.cond on the (replicated) step counter
-                # so each step pays exactly ONE collective: dense pmean during
-                # warmup, the 1-bit all_to_all+allgather once frozen — the
-                # bandwidth saving that is the point of 1-bit optimizers
-                # (reference onebit/adam.py: freeze_step switches comm paths).
-                def warm_branch(g, m, v, we, se):
-                    g_dense = jax.lax.pmean(g, self.axis)
-                    m_new = self.b1 * m + (1 - self.b1) * g_dense
-                    v_new = self.b2 * v + (1 - self.b2) * g_dense**2
-                    return m_new, v_new, we, se
-
-                def comp_branch(g, m, v, we, se):
-                    m_local = self.b1 * m + (1 - self.b1) * g
-                    m_new, we_new, se_new = sync_momentum_compressed(
-                        m_local, we, se, self.axis, self.n)
-                    return m_new, v, we_new, se_new
-
-                m_new, v_new, we_new, se_new = jax.lax.cond(
-                    warm, warm_branch, comp_branch, g, m, v, we, se)
-            else:
-                m_new = self.b1 * m + (1 - self.b1) * g
-                v_new = jnp.where(
-                    warm, self.b2 * v + (1 - self.b2) * g**2, v)
-                we_new, se_new = we, se
-            if we2d:
-                we_new = we_new[None]
-            if se2d:
-                se_new = se_new[None]
-            # --- Adam step with bias correction ---------------------------
-            bc1 = 1 - self.b1 ** t
-            bc2 = 1 - self.b2 ** t
-            denom = jnp.sqrt(v_new / bc2) + self.eps
-            upd = (m_new / bc1) / denom
+        def leaf(p, m, gd, v):
+            gd = gd.astype(jnp.float32)
+            v_new = jnp.where(warm, self.b2 * v + (1 - self.b2) * gd**2, v)
+            upd = (m / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
             if self.weight_decay:
                 upd = upd + self.weight_decay * p
-            return p - lr * upd, m_new, v_new, we_new, se_new
+            return p - lr * upd, v_new
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_m = treedef.flatten_up_to(state.m)
-        flat_v = treedef.flatten_up_to(state.v)
-        flat_we = treedef.flatten_up_to(state.worker_error)
-        flat_se = treedef.flatten_up_to(state.server_error)
-        out = [leaf(*args) for args in
-               zip(flat_p, flat_g, flat_m, flat_v, flat_we, flat_se)]
+        out = [leaf(*args) for args in zip(
+            flat_p,
+            treedef.flatten_up_to(m_new),
+            treedef.flatten_up_to(g_dense),
+            treedef.flatten_up_to(state.v))]
         unflat = lambda i: jax.tree_util.tree_unflatten(
             treedef, [o[i] for o in out])
-        new_state = OneBitState(step=step, m=unflat(1), v=unflat(2),
-                                worker_error=unflat(3), server_error=unflat(4))
+        new_state = OneBitState(step=step, m=m_new, v=unflat(1),
+                                worker_error=we_new, server_error=se_new)
         return unflat(0), new_state
+
+    def update(self, grads, state: OneBitState, params, lr=None):
+        """Monolithic step (sync + apply) for direct use inside a manual
+        region; grads are LOCAL (per-rank)."""
+        m_new, gd, we_new, se_new = self.sync_phase(
+            grads, state.m, state.worker_error, state.server_error,
+            state.step)
+        return self.finish_step(params, state, m_new, gd, we_new, se_new, lr)

@@ -1,0 +1,179 @@
+"""Shared machinery for the 1-bit optimizers (OneBitAdam / OneBitLamb).
+
+The engine splits a 1-bit step into two phases. The reference composes its
+1-bit optimizers with engine flavors at runtime by switching communication
+paths (``deepspeed/runtime/fp16/onebit/adam.py:92-104`` probes the engine
+for ``pipeline_enable_backward_allreduce``); here the composition is
+structural:
+
+- ``sync_phase`` runs INSIDE the engine's manual ``shard_map`` region
+  (axes: the compression axis, the dense ICI-inner data axis on
+  hierarchical meshes, plus ``pipe`` under the PipelineEngine) on
+  rank-LOCAL gradients. It performs a dense ``pmean`` during warmup and the
+  error-compensated 1-bit collective (comm/compressed.py) once frozen —
+  gated by ``lax.cond`` on the replicated step counter so each step pays
+  exactly ONE collective family.
+- ``finish_step`` runs in GSPMD-auto mode: the elementwise optimizer apply.
+  ZeRO-1 optimizer-state sharding (the engine's ``opt_specs``) composes
+  freely here — XLA inserts the gather/slice collectives implied by the
+  sharding mismatch, exactly the placement-policy realisation of ZeRO
+  (runtime/zero/partition.py) — because the compressed protocol constrains
+  the *sync*, not the state placement.
+
+Error-feedback buffers are per-rank persistent state in a flat, 8·n-aligned
+layout (n = compression-axis size). Under pipeline parallelism a param leaf
+is pipe-sharded (the stacked-blocks dim), so the buffers are laid out per
+LOCAL shard: ``[n, S * pad(local_numel)]`` sharded ``(comp_axis, pipe)``;
+``configure_partitioning`` records the manual shard factor per leaf. Inside
+the manual region each rank then sees the same ``[1, pad]`` local view
+regardless of pipeline composition.
+"""
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.comm.compressed import sync_momentum_compressed
+from deepspeed_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
+
+
+def _pad_len(numel: int, n: int) -> int:
+    align = 8 * n
+    return (numel + align - 1) // align * align
+
+
+class OneBitBase:
+    """Common state-layout + sync-phase machinery. Subclasses add their
+    moment/apply math (``init``/``state_specs``/``finish_step``) and keep a
+    monolithic ``update`` for direct (non-engine) use."""
+
+    needs_local_grads = True
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 freeze_step: int = 100, mesh=None, axis: str = DATA_AXIS,
+                 comm_size: int = None, **_ignored):
+        self.lr = float(lr)
+        self.b1, self.b2 = float(betas[0]), float(betas[1])
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.freeze_step = int(freeze_step)
+        self.axis = axis
+        self.n = int(comm_size if comm_size is not None
+                     else (mesh.shape.get(axis, 1) if mesh is not None else 1))
+        self._base_specs = None
+        self._mesh_shape = dict(mesh.shape) if mesh is not None else {}
+        self._shard_axes: Tuple[str, ...] = (PIPE_AXIS,)
+
+    # -- partition-aware error-buffer layout -------------------------------
+    def configure_partitioning(self, base_specs: Any, mesh,
+                               shard_axes: Tuple[str, ...] = (PIPE_AXIS,)):
+        """Record which MANUAL mesh axes shard each param leaf (the
+        pipeline's stacked-blocks dim). Must be called before ``init`` when
+        params carry manual shardings; model/sequence axes stay GSPMD-auto
+        and are ignored here."""
+        self._base_specs = base_specs
+        self._mesh_shape = dict(mesh.shape) if mesh is not None else {}
+        self._shard_axes = tuple(shard_axes)
+
+    def _flat_with_specs(self, params):
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        if self._base_specs is None:
+            specs = [None] * len(flat)
+        else:
+            specs = treedef.flatten_up_to(self._base_specs)
+        return flat, treedef, specs
+
+    def _leaf_layout(self, p, spec):
+        """(manual shard axes, S, pad) for one param leaf: S = product of
+        manual-axis sizes sharding it, pad = aligned LOCAL flat length."""
+        numel = int(np.prod(p.shape) or 1)
+        axes = []
+        if spec is not None:
+            for entry in tuple(spec):
+                parts = entry if isinstance(entry, tuple) else (entry,)
+                axes += [a for a in parts if a in self._shard_axes]
+        S = 1
+        for a in axes:
+            S *= self._mesh_shape.get(a, 1)
+        if numel % S:
+            raise ValueError(
+                f"param numel {numel} not divisible by manual shard factor "
+                f"{S} (axes {axes})")
+        return tuple(axes), S, _pad_len(numel // S, self.n)
+
+    def _init_error_buffers(self, params):
+        flat, treedef, specs = self._flat_with_specs(params)
+        we, se = [], []
+        for p, s in zip(flat, specs):
+            _, S, pad = self._leaf_layout(p, s)
+            we.append(jnp.zeros((self.n, S * pad), jnp.float32))
+            se.append(jnp.zeros((self.n, S * pad // self.n), jnp.float32))
+        unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        return unflat(we), unflat(se)
+
+    def _error_specs(self, params):
+        """Leading dim over the compression axis; second dim over the
+        manual shard axes (pipe) when the leaf is pipe-sharded."""
+        flat, treedef, specs = self._flat_with_specs(params)
+        we_s = []
+        for p, s in zip(flat, specs):
+            axes, S, _ = self._leaf_layout(p, s)
+            if S > 1:
+                dim1 = axes[0] if len(axes) == 1 else tuple(axes)
+                we_s.append(PartitionSpec(self.axis, dim1))
+            else:
+                we_s.append(PartitionSpec(self.axis))
+        spec_tree = jax.tree_util.tree_unflatten(treedef, we_s)
+        return spec_tree, spec_tree  # worker and server shard identically
+
+    # -- phase 1: rank-local momentum sync (manual region) -----------------
+    def sync_phase(self, grads, m, worker_error, server_error, step):
+        """grads are LOCAL (per-rank along the compression axis; per-shard
+        along pipe). Returns ``(m_new, g_dense, we_new, se_new)``:
+        ``m_new`` is the synchronised momentum (identical across the
+        compression axis), ``g_dense`` the densely-averaged gradient during
+        warmup (the local gradient — unused downstream — once frozen)."""
+        warm = (step + 1) <= self.freeze_step
+
+        def leaf(g, m, we, se):
+            g = g.astype(jnp.float32)
+            we2d, se2d = we.ndim == 2, se.ndim == 2
+            if we2d:
+                we = we[0]
+            if se2d:
+                se = se[0]
+            if self.n > 1:
+                def warm_branch(g, m, we, se):
+                    gd = jax.lax.pmean(g, self.axis)
+                    return self.b1 * m + (1 - self.b1) * gd, gd, we, se
+
+                def comp_branch(g, m, we, se):
+                    m_local = self.b1 * m + (1 - self.b1) * g
+                    m_new, we_new, se_new = sync_momentum_compressed(
+                        m_local, we, se, self.axis, self.n)
+                    return m_new, g, we_new, se_new
+
+                m_new, gd, we_new, se_new = jax.lax.cond(
+                    warm, warm_branch, comp_branch, g, m, we, se)
+            else:
+                m_new = self.b1 * m + (1 - self.b1) * g
+                gd, we_new, se_new = g, we, se
+            if we2d:
+                we_new = we_new[None]
+            if se2d:
+                se_new = se_new[None]
+            return m_new, gd, we_new, se_new
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        out = [leaf(*args) for args in zip(
+            flat_g,
+            treedef.flatten_up_to(m),
+            treedef.flatten_up_to(worker_error),
+            treedef.flatten_up_to(server_error))]
+        unflat = lambda i: jax.tree_util.tree_unflatten(
+            treedef, [o[i] for o in out])
+        return unflat(0), unflat(1), unflat(2), unflat(3)

@@ -7,19 +7,18 @@ coefficients — only 1-bit momentum crosses the wire (the reference likewise
 freezes per-layer ``scaling_coeff`` at the boundary rather than recomputing
 trust from sign-compressed momentum).
 
-The two phases are gated with ``lax.cond`` on the replicated step counter so
-each step pays exactly one collective family (dense ``pmean`` in warmup, the
-1-bit ``all_to_all``+``allgather`` afterwards).
+Split into ``sync_phase`` (manual region, shared with OneBitAdam via
+ops/onebit/common.py) and ``finish_step`` (GSPMD-auto apply, where the
+trust-ratio norms and ZeRO-1 state sharding are XLA's problem).
 """
 
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+from jax.sharding import PartitionSpec
 
-from deepspeed_tpu.comm.compressed import sync_momentum_compressed
-from deepspeed_tpu.ops.onebit.adam import _pad_len
+from deepspeed_tpu.ops.onebit.common import OneBitBase
 from deepspeed_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -27,126 +26,79 @@ class LambState(NamedTuple):
     step: jax.Array
     m: Any              # first moment (per-param tree)
     v: Any              # second moment (frozen after warmup)
-    worker_error: Any   # flat error-feedback per param [padded numel]
-    server_error: Any   # flat server error per param [padded numel / n]
+    worker_error: Any   # flat error-feedback per param [n, S·pad]
+    server_error: Any   # flat server error per param [n, S·pad / n]
     scale: Any          # per-param trust ratio (frozen after warmup)
 
 
-class OneBitLamb:
-    needs_local_grads = True
-
+class OneBitLamb(OneBitBase):
     def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-6,
                  weight_decay: float = 0.0, freeze_step: int = 100,
                  max_trust_ratio: float = 10.0, mesh=None,
                  axis: str = DATA_AXIS, comm_size: int = None, **_ignored):
-        self.lr = float(lr)
-        self.b1, self.b2 = float(betas[0]), float(betas[1])
-        self.eps = float(eps)
-        self.weight_decay = float(weight_decay)
-        self.freeze_step = int(freeze_step)
+        super().__init__(lr=lr, betas=betas, eps=eps,
+                         weight_decay=weight_decay, freeze_step=freeze_step,
+                         mesh=mesh, axis=axis, comm_size=comm_size)
         self.max_trust = float(max_trust_ratio)
-        self.axis = axis
-        self.n = int(comm_size if comm_size is not None
-                     else (mesh.shape.get(axis, 1) if mesh is not None else 1))
 
     def init(self, params):
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        we, se = self._init_error_buffers(params)
         return LambState(
             step=jnp.zeros((), jnp.int32),
             m=jax.tree_util.tree_map(zeros, params),
             v=jax.tree_util.tree_map(zeros, params),
-            worker_error=jax.tree_util.tree_map(
-                lambda p: jnp.zeros(
-                    (self.n, _pad_len(int(np.prod(p.shape) or 1), self.n)),
-                    jnp.float32), params),
-            server_error=jax.tree_util.tree_map(
-                lambda p: jnp.zeros(
-                    (self.n, _pad_len(int(np.prod(p.shape) or 1), self.n)
-                     // self.n), jnp.float32), params),
+            worker_error=we, server_error=se,
             scale=jax.tree_util.tree_map(
                 lambda _: jnp.ones((), jnp.float32), params))
 
-    def state_specs(self, params):
-        from jax.sharding import PartitionSpec as P
+    def state_specs(self, params, opt_specs=None):
+        rep = jax.tree_util.tree_map(lambda _: PartitionSpec(), params)
+        mv = opt_specs if opt_specs is not None else rep
+        we_s, se_s = self._error_specs(params)
+        return LambState(step=PartitionSpec(), m=mv, v=mv,
+                         worker_error=we_s, server_error=se_s, scale=rep)
 
-        rep = jax.tree_util.tree_map(lambda _: P(), params)
-        shard0 = jax.tree_util.tree_map(lambda _: P(self.axis), params)
-        return LambState(step=P(), m=rep, v=rep,
-                         worker_error=shard0, server_error=shard0, scale=rep)
-
-    def update(self, grads, state: LambState, params, lr=None):
+    # ------------------------------------------------------------------
+    def finish_step(self, params, state: LambState, m_new, g_dense,
+                    we_new, se_new, lr=None):
         lr = self.lr if lr is None else lr
         step = state.step + 1
         t = step.astype(jnp.float32)
         warm = step <= self.freeze_step
+        bc1 = 1 - self.b1 ** t
+        bc2 = 1 - self.b2 ** t
 
-        def leaf(p, g, m, v, we, se, sc):
-            g = g.astype(jnp.float32)
-            we2d, se2d = we.ndim == 2, se.ndim == 2
-            if we2d:
-                we = we[0]
-            if se2d:
-                se = se[0]
-            bc1 = 1 - self.b1 ** t
-            bc2 = 1 - self.b2 ** t
-
-            def trust_of(pp, upd):
-                w_norm = jnp.linalg.norm(pp.reshape(-1))
-                u_norm = jnp.linalg.norm(upd.reshape(-1))
-                return jnp.where(
-                    (w_norm > 0) & (u_norm > 0),
-                    jnp.clip(w_norm / u_norm, 0.0, self.max_trust), 1.0)
-
-            def finish(m_new, v_new, we_new, se_new, sc_new):
-                upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
-                if self.weight_decay:
-                    upd = upd + self.weight_decay * p
-                return upd, m_new, v_new, we_new, se_new, sc_new
-
-            if self.n > 1:
-                def warm_branch(g, m, v, we, se, sc):
-                    g_dense = jax.lax.pmean(g, self.axis)
-                    m_new = self.b1 * m + (1 - self.b1) * g_dense
-                    v_new = self.b2 * v + (1 - self.b2) * g_dense**2
-                    upd, *rest = finish(m_new, v_new, we, se, sc)
-                    trust = trust_of(p, upd)
-                    return (p - lr * trust * upd, *rest[:4], trust)
-
-                def comp_branch(g, m, v, we, se, sc):
-                    m_local = self.b1 * m + (1 - self.b1) * g
-                    m_new, we_new, se_new = sync_momentum_compressed(
-                        m_local, we, se, self.axis, self.n)
-                    upd, *rest = finish(m_new, v, we_new, se_new, sc)
-                    return (p - lr * sc * upd, *rest[:4], sc)
-
-                p_new, m_new, v_new, we_new, se_new, sc_new = jax.lax.cond(
-                    warm, warm_branch, comp_branch, g, m, v, we, se, sc)
-            else:
-                m_new = self.b1 * m + (1 - self.b1) * g
-                v_new = jnp.where(
-                    warm, self.b2 * v + (1 - self.b2) * g**2, v)
-                upd, _, _, we_new, se_new, _ = finish(m_new, v_new, we, se, sc)
-                trust = trust_of(p, upd)
-                sc_new = jnp.where(warm, trust, sc)
-                p_new = p - lr * sc_new * upd
-            if we2d:
-                we_new = we_new[None]
-            if se2d:
-                se_new = se_new[None]
-            return p_new, m_new, v_new, we_new, se_new, sc_new
+        def leaf(p, m, gd, v, sc):
+            gd = gd.astype(jnp.float32)
+            v_new = jnp.where(warm, self.b2 * v + (1 - self.b2) * gd**2, v)
+            upd = (m / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p
+            w_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(upd.reshape(-1))
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, 0.0, self.max_trust), 1.0)
+            sc_new = jnp.where(warm, trust, sc)
+            return p - lr * sc_new * upd, v_new, sc_new
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         out = [leaf(*args) for args in zip(
             flat_p,
-            treedef.flatten_up_to(grads),
-            treedef.flatten_up_to(state.m),
+            treedef.flatten_up_to(m_new),
+            treedef.flatten_up_to(g_dense),
             treedef.flatten_up_to(state.v),
-            treedef.flatten_up_to(state.worker_error),
-            treedef.flatten_up_to(state.server_error),
             treedef.flatten_up_to(state.scale))]
         unflat = lambda i: jax.tree_util.tree_unflatten(
             treedef, [o[i] for o in out])
-        new_state = LambState(step=step, m=unflat(1), v=unflat(2),
-                              worker_error=unflat(3), server_error=unflat(4),
-                              scale=unflat(5))
+        new_state = LambState(step=step, m=m_new, v=unflat(1),
+                              worker_error=we_new, server_error=se_new,
+                              scale=unflat(2))
         return unflat(0), new_state
+
+    def update(self, grads, state: LambState, params, lr=None):
+        m_new, gd, we_new, se_new = self.sync_phase(
+            grads, state.m, state.worker_error, state.server_error,
+            state.step)
+        return self.finish_step(params, state, m_new, gd, we_new, se_new, lr)

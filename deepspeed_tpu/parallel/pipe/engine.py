@@ -24,7 +24,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from deepspeed_tpu.config.config import DeepSpeedTPUConfig
 from deepspeed_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
 from deepspeed_tpu.parallel.pipe.module import PipeModel
-from deepspeed_tpu.parallel.pipe.pipeline import pipeline_apply, pipeline_spec
+from deepspeed_tpu.parallel.pipe.pipeline import (pipeline_apply,
+                                                  pipeline_apply_manual,
+                                                  pipeline_spec)
 from deepspeed_tpu.runtime.engine import TPUEngine, TrainState
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -63,24 +65,14 @@ class PipelineEngine(TPUEngine):
     def _unused_loss_fn(params, batch, rng):
         raise RuntimeError("PipelineEngine compiles its own loss path")
 
-    # ------------------------------------------------------------------
-    def _build_step_fns(self) -> None:
-        cfg = self.config
-        gas = cfg.gradient_accumulation_steps
-        fp16 = cfg.fp16.enabled
-        precision = self.precision
-        mesh = self.mesh
+    def _make_pipe_loss(self):
+        """loss(compute_params, batches, rng) through the GSPMD pipelined
+        program (batches leaves [M, mb, ...]; rng=None ≡ eval/dropout off)."""
         pm = self.pipe_model
-        scaler = self.loss_scaler
+        gas = self.config.gradient_accumulation_steps
+        mesh = self.mesh
 
-        grad_shardings = jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), self.grad_specs)
-        apply_step = self._make_apply_step()
-
-        predivide = cfg.prescale_gradients
-
-        def pipe_loss(compute_params, batches, rng, scale):
-            # batches leaves: [M, mb, ...]; rng=None ≡ eval (dropout off).
+        def pipe_loss(compute_params, batches, rng):
             def embed_one(b, i):
                 k = None if rng is None else jax.random.fold_in(rng, i)
                 return pm.embed_fn(compute_params, b, k)
@@ -99,7 +91,128 @@ class PipelineEngine(TPUEngine):
                                remat_blocks=True)
             losses = jax.vmap(
                 lambda hm, bm: pm.head_fn(compute_params, hm, bm))(h, batches)
-            loss = jnp.mean(losses.astype(jnp.float32))
+            return jnp.mean(losses.astype(jnp.float32))
+
+        return pipe_loss
+
+    def _make_pipe_eval_step(self):
+        precision = self.precision
+        pipe_loss = self._make_pipe_loss()
+
+        def eval_step(state: TrainState, batches):
+            compute_params = precision.cast_params(state.params)
+            return pipe_loss(compute_params, batches, None), None
+
+        return eval_step
+
+    # ------------------------------------------------------------------
+    # 1-bit composition (BASELINE ladder final rung: pipe + ZeRO-1 +
+    # OneBitAdam). The base engine's two-phase local-grad builder is reused;
+    # these hooks add the pipe axis to the manual region and swap the GAS
+    # scan for ONE pipelined fwd/bwd over all microbatches.
+    # ------------------------------------------------------------------
+    def _local_grad_axes(self):
+        comp_axis, dense_axis, manual_axes = super()._local_grad_axes()
+        if PIPE_AXIS in self.mesh.shape:
+            manual_axes = set(manual_axes) | {PIPE_AXIS}
+        return comp_axis, dense_axis, manual_axes
+
+    def _local_grad_sq(self, grads):
+        """Block grads are pipe-LOCAL shards (sum their squares over pipe);
+        non-block grads are full gradients identical on every pipe rank
+        after the psum fix-up (count once)."""
+        from deepspeed_tpu.runtime.utils import global_norm
+
+        if self.mesh.shape.get(PIPE_AXIS, 1) <= 1:
+            return global_norm(grads) ** 2
+        sq_blocks = global_norm(grads["blocks"]) ** 2
+        rest = {k: v for k, v in grads.items() if k != "blocks"}
+        sq_rest = global_norm(rest) ** 2 if rest else jnp.float32(0.0)
+        return jax.lax.psum(sq_blocks, PIPE_AXIS) + sq_rest
+
+    def _local_grad_forward_backward(self, comp_axis, dense_axis):
+        """ONE pipelined fwd/bwd over all GAS microbatches inside the
+        manual region. Gradient provenance over ``pipe``: the head/loss is
+        computed (and masked) on the LAST stage only and the pipelined
+        body keeps activations per stage, so embedding grads land on pipe
+        rank 0, head grads on rank S-1, and block grads on their owning
+        stage — one uniform psum-over-pipe then yields the full gradient
+        for every non-block leaf (tied embeddings included: the psum
+        collects the rank-0 embed part and the rank-(S-1) head part)."""
+        gas = self.config.gradient_accumulation_steps
+        pm = self.pipe_model
+        stages = self.mesh.shape.get(PIPE_AXIS, 1)
+
+        def run(compute_params, grad_acc, sub, scale, batches):
+            def pipe_loss(cp):
+                def embed_one(b, i):
+                    k = jax.random.fold_in(sub, i)
+                    return pm.embed_fn(cp, b, k)
+
+                embeds = jax.vmap(embed_one)(batches, jnp.arange(gas))
+                aux = None
+                if pm.aux_fn is not None:
+                    first = jax.tree_util.tree_map(lambda x: x[0], batches)
+                    if pm.aux_fn(cp, first) is not None:
+                        aux = jax.vmap(lambda b: pm.aux_fn(cp, b))(batches)
+                h = pipeline_apply_manual(
+                    pm.block_fn, cp["blocks"], embeds, aux, sub,
+                    stages=stages, num_microbatches=gas, remat_blocks=True,
+                    broadcast_output=False)
+                if stages > 1:
+                    last = jax.lax.axis_index(PIPE_AXIS) == stages - 1
+                    # Zero invalid-rank activations BEFORE the head so the
+                    # masked loss's zero cotangent multiplies finite values
+                    # (garbage bf16 activations can reach inf; 0·inf = NaN
+                    # in the backward).
+                    h = jnp.where(last, h, jnp.zeros_like(h))
+                losses = jax.vmap(
+                    lambda hm, bm: pm.head_fn(cp, hm, bm))(h, batches)
+                loss = jnp.mean(losses.astype(jnp.float32))
+                if stages > 1:
+                    loss = jax.lax.psum(jnp.where(last, loss, 0.0),
+                                        PIPE_AXIS)
+                return loss * scale, loss
+
+            (_, loss), grads = jax.value_and_grad(
+                pipe_loss, has_aux=True)(compute_params)
+            grads = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(a.dtype), grad_acc, grads)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / scale, grads)
+            if stages > 1:
+                grads = {k: (v if k == "blocks" else jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, PIPE_AXIS), v))
+                    for k, v in grads.items()}
+            return grads, loss
+
+        return run
+
+    # ------------------------------------------------------------------
+    def _build_step_fns(self) -> None:
+        if getattr(self.optimizer, "needs_local_grads", False):
+            self._build_local_grad_step_fns()
+            # The base eval step calls loss_fn; pipelines evaluate through
+            # the pipelined program instead.
+            self._eval_step = jax.jit(self._make_pipe_eval_step())
+            return
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        fp16 = cfg.fp16.enabled
+        precision = self.precision
+        mesh = self.mesh
+        pm = self.pipe_model
+        scaler = self.loss_scaler
+
+        grad_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self.grad_specs)
+        apply_step = self._make_apply_step()
+
+        predivide = cfg.prescale_gradients
+        raw_pipe_loss = self._make_pipe_loss()
+
+        def pipe_loss(compute_params, batches, rng, scale):
+            loss = raw_pipe_loss(compute_params, batches, rng)
             scaled = loss * scale
             if predivide:
                 # Mirrors the base engine's pre-division, undone in

@@ -147,18 +147,26 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
     ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32)
 
     def head_fn(params, x, batch):
+        from deepspeed_tpu.models.gpt import cross_entropy_with_ignore
         from deepspeed_tpu.ops.xent import fused_cross_entropy
 
         h = ln_f.apply({"params": params["head"]["ln_f"]}, x)
         labels = shift_labels(batch)
         if cfg.tie_embeddings:
-            return fused_cross_entropy(
-                h.astype(cfg.dtype),
-                params["embed"]["wte"].astype(cfg.dtype), labels)
-        kernel = params["head"]["lm_head"]["kernel"]
-        return fused_cross_entropy(h.astype(cfg.dtype),
-                                   kernel.astype(cfg.dtype), labels,
-                                   w_transposed=True)
+            w, wt = params["embed"]["wte"], False
+        else:
+            w, wt = params["head"]["lm_head"]["kernel"], True
+        if not getattr(cfg, "fused_ce", True):
+            # Honor the family's opt-out (ADVICE r3): exact fp32 logits +
+            # stock log-softmax CE, as models/gpt.py's unfused branch.
+            logits = jnp.einsum("bsd,vd->bsv" if not wt else "bsd,dv->bsv",
+                                h.astype(cfg.dtype), w.astype(cfg.dtype),
+                                preferred_element_type=jnp.float32)
+            return cross_entropy_with_ignore(logits, labels)
+        return fused_cross_entropy(
+            h.astype(cfg.dtype), w.astype(cfg.dtype), labels,
+            w_transposed=wt,
+            logits_fp32=getattr(cfg, "fused_ce_fp32_logits", False))
 
     return PipeModel(embed_fn=embed_fn, block_fn=block_fn,
                      head_fn=head_fn, aux_fn=aux_fn, params=params,

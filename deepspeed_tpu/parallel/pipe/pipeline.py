@@ -55,6 +55,115 @@ def pipeline_spec(blocks_params) -> Any:
 _PIPELINE_CACHE = {}
 
 
+def pipeline_apply_manual(block_fn: Callable,
+                          stage_blocks: Any,
+                          x_all: jax.Array,
+                          aux_all: Any,
+                          keys: Optional[jax.Array],
+                          *,
+                          stages: int,
+                          num_microbatches: int,
+                          remat_blocks: bool = True,
+                          broadcast_output: bool = True) -> jax.Array:
+    """The manual-region pipeline body: call INSIDE a shard_map already
+    manual over ``pipe`` (``stage_blocks`` leaves carry the local
+    ``[L/S, ...]`` shard; ``x_all`` ``[M, mb, ...]`` is pipe-replicated).
+
+    With ``broadcast_output`` (default) the last stage's microbatch outputs
+    are psum-broadcast to every pipe rank in fp32; with it off the raw
+    last-stage slice is returned and ONLY rank ``stages-1`` holds valid
+    data — callers that mask per-rank themselves (the 1-bit pipeline
+    engine) use this to keep gradient provenance per stage.
+
+    With ``stages == 1`` this degenerates to a scan over blocks per
+    microbatch (no collectives emitted)."""
+    M = num_microbatches
+    fn = jax.checkpoint(block_fn) if remat_blocks else block_fn
+
+    def stage_apply(h, a, key):
+        # Apply this stage's L/S blocks in order (scan keeps the program
+        # small; blocks are structurally identical by contract).
+        def body(h, xs):
+            p, i = xs
+            k = None if key is None else jax.random.fold_in(key, i)
+            return fn(p, h, a, k), None
+
+        n = jax.tree_util.tree_leaves(stage_blocks)[0].shape[0]
+        h, _ = jax.lax.scan(body, h, (stage_blocks, jnp.arange(n)))
+        return h
+
+    def aux_at(idx):
+        if aux_all is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, idx, axis=0,
+                                                   keepdims=False), aux_all)
+
+    if stages == 1:
+        def per_mb(mb, i):
+            key = None if keys is None else jax.random.fold_in(keys, i)
+            return stage_apply(mb, aux_at(i), key)
+
+        if aux_all is None:
+            return jax.vmap(per_mb)(x_all, jnp.arange(M))
+        # aux indexing is data-dependent per microbatch — use scan
+        def body(_, mi):
+            mb, i = mi
+            return None, per_mb(mb, i)
+
+        _, out = jax.lax.scan(body, None, (x_all, jnp.arange(M)))
+        return out
+
+    T = M + stages - 1
+    rank = jax.lax.axis_index(PIPE_AXIS)
+    shift = [(i, (i + 1) % stages) for i in range(stages)]
+
+    def tick(carry, t):
+        buf = carry
+        inject = jax.lax.dynamic_index_in_dim(
+            x_all, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+        h = jnp.where(rank == 0, inject, buf)
+        # Stage `rank` processes microbatch m = t - rank at tick t;
+        # fill/drain ticks (m outside [0, M)) carry garbage that no
+        # valid tick ever consumes (producer (r-1, t-1) has the same m
+        # as consumer (r, t)). Executing stage_apply on those ticks
+        # does NOT cost wall-clock — the ppermute keeps ranks in
+        # lockstep and some rank is always active, so the step time is
+        # the critical-path bound T·stage_time either way (proven by
+        # tests/test_pipeline.py::test_step_time_approaches_bubble_
+        # bound); it costs only energy on the (S-1)/(M+S-1) bubble
+        # fraction. A `lax.cond` on the validity predicate would skip
+        # that too and is semantically safe here (garbage flows only
+        # into garbage), and it transposes/remats correctly in minimal
+        # repros — but the full model aborts XLA:CPU at runtime under
+        # this partial-manual shard_map (same backend fragility as the
+        # bf16-psum note below), and with one real TPU chip a
+        # TPU-only branch would ship unexercised. Revisit when the
+        # backend bug is gone (tracked: docs/ISSUES.md #1).
+        m = t - rank
+        a = aux_at(jnp.clip(m, 0, M - 1))
+        k = (None if keys is None
+             else jax.random.fold_in(jax.random.fold_in(keys, t), rank))
+        y = stage_apply(h, a, k)
+        buf = jax.lax.ppermute(y, PIPE_AXIS, shift)
+        return buf, y
+
+    _, ys = jax.lax.scan(tick, jnp.zeros_like(x_all[0]), jnp.arange(T))
+    # Last stage produced microbatch m at tick m + S - 1.
+    out = jax.lax.dynamic_slice_in_dim(ys, stages - 1, M, axis=0)
+    if not broadcast_output:
+        return out
+    # Hand the result to every pipe rank (the reference broadcasts the
+    # final-stage loss similarly, pipe/engine.py:453); activations of
+    # non-final stages are discarded by the where. The psum runs in fp32:
+    # a bf16 all-reduce under a partial-manual shard_map crashes the XLA
+    # CPU backend ("Invalid binary instruction opcode copy"), and fp32
+    # summation is the numerically safer choice anyway.
+    masked = jnp.where(rank == stages - 1, out,
+                       jnp.zeros_like(out)).astype(jnp.float32)
+    return jax.lax.psum(masked, PIPE_AXIS).astype(out.dtype)
+
+
 def pipeline_apply(block_fn: Callable,
                    blocks_params: Any,
                    x: jax.Array,
@@ -87,99 +196,24 @@ def pipeline_apply(block_fn: Callable,
     if x.shape[0] != M:
         raise ValueError(f"x has {x.shape[0]} microbatches, expected {M}")
 
-    fn = jax.checkpoint(block_fn) if remat_blocks else block_fn
-
-    def stage_apply(stage_blocks, h, a, key):
-        # Apply this stage's L/S blocks in order (scan keeps the program
-        # small; blocks are structurally identical by contract).
-        def body(h, xs):
-            p, i = xs
-            k = None if key is None else jax.random.fold_in(key, i)
-            return fn(p, h, a, k), None
-
-        n = jax.tree_util.tree_leaves(stage_blocks)[0].shape[0]
-        h, _ = jax.lax.scan(body, h, (stage_blocks, jnp.arange(n)))
-        return h
-
-    def aux_at(aux_all, idx):
-        if aux_all is None:
-            return None
-        return jax.tree_util.tree_map(
-            lambda a: jax.lax.dynamic_index_in_dim(a, idx, axis=0,
-                                                   keepdims=False), aux_all)
-
     if stages == 1:
-        def per_mb(mb, i):
-            key = None if rng is None else jax.random.fold_in(rng, i)
-            a = aux_at(aux, i) if aux is not None else None
-            return stage_apply(blocks_params, mb, a, key)
+        return pipeline_apply_manual(block_fn, blocks_params, x, aux, rng,
+                                     stages=1, num_microbatches=M,
+                                     remat_blocks=remat_blocks)
 
-        if aux is None:
-            return jax.vmap(lambda mb, i: per_mb(mb, i))(x, jnp.arange(M))
-        # aux indexing is data-dependent per microbatch — use scan
-        def body(_, mi):
-            mb, i = mi
-            return None, per_mb(mb, i)
-
-        _, out = jax.lax.scan(body, None, (x, jnp.arange(M)))
-        return out
-
-    T = M + stages - 1
     compute_dtype = x.dtype
 
     def pipelined(stage_blocks, x_all, aux_all, keys):
         # stage_blocks leaves: [L/S, ...] (pipe dim stripped; other axes
         # remain GSPMD-auto); x_all: [M, mb, ...] replicated across pipe.
-        # x crosses the shard_map boundary in fp32 (see psum note below:
-        # the cotangent of a pipe-replicated input is a psum, which must
-        # not run in bf16 under a partial-manual shard_map).
-        x_all = x_all.astype(compute_dtype)
-        rank = jax.lax.axis_index(PIPE_AXIS)
-        shift = [(i, (i + 1) % stages) for i in range(stages)]
-
-        def tick(carry, t):
-            buf = carry
-            inject = jax.lax.dynamic_index_in_dim(
-                x_all, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
-            h = jnp.where(rank == 0, inject, buf)
-            # Stage `rank` processes microbatch m = t - rank at tick t;
-            # fill/drain ticks (m outside [0, M)) carry garbage that no
-            # valid tick ever consumes (producer (r-1, t-1) has the same m
-            # as consumer (r, t)). Executing stage_apply on those ticks
-            # does NOT cost wall-clock — the ppermute keeps ranks in
-            # lockstep and some rank is always active, so the step time is
-            # the critical-path bound T·stage_time either way (proven by
-            # tests/test_pipeline.py::test_step_time_approaches_bubble_
-            # bound); it costs only energy on the (S-1)/(M+S-1) bubble
-            # fraction. A `lax.cond` on the validity predicate would skip
-            # that too and is semantically safe here (garbage flows only
-            # into garbage), and it transposes/remats correctly in minimal
-            # repros — but the full model aborts XLA:CPU at runtime under
-            # this partial-manual shard_map (same backend fragility as the
-            # bf16-psum note below), and with one real TPU chip a
-            # TPU-only branch would ship unexercised. Revisit when the
-            # backend bug is gone.
-            m = t - rank
-            a = aux_at(aux_all, jnp.clip(m, 0, M - 1))
-            k = (None if keys is None
-                 else jax.random.fold_in(jax.random.fold_in(keys, t), rank))
-            y = stage_apply(stage_blocks, h, a, k)
-            buf = jax.lax.ppermute(y, PIPE_AXIS, shift)
-            return buf, y
-
-        _, ys = jax.lax.scan(tick, jnp.zeros_like(x_all[0]),
-                             jnp.arange(T))
-        # Last stage produced microbatch m at tick m + S - 1.
-        out = jax.lax.dynamic_slice_in_dim(ys, stages - 1, M, axis=0)
-        # Hand the result to every pipe rank (the reference broadcasts the
-        # final-stage loss similarly, pipe/engine.py:453); activations of
-        # non-final stages are discarded by the where. The psum runs in fp32:
-        # a bf16 all-reduce under a partial-manual shard_map crashes the XLA
-        # CPU backend ("Invalid binary instruction opcode copy"), and fp32
-        # summation is the numerically safer choice anyway.
-        masked = jnp.where(rank == stages - 1, out,
-                           jnp.zeros_like(out)).astype(jnp.float32)
-        return jax.lax.psum(masked, PIPE_AXIS).astype(out.dtype)
+        # x crosses the shard_map boundary in fp32 (see psum note in
+        # pipeline_apply_manual: the cotangent of a pipe-replicated input
+        # is a psum, which must not run in bf16 under a partial-manual
+        # shard_map).
+        return pipeline_apply_manual(
+            block_fn, stage_blocks, x_all.astype(compute_dtype), aux_all,
+            keys, stages=stages, num_microbatches=M,
+            remat_blocks=remat_blocks, broadcast_output=True)
 
     blocks_treedef = jax.tree_util.tree_structure(blocks_params)
     blocks_ndims = tuple(l.ndim for l in jax.tree_util.tree_leaves(blocks_params))

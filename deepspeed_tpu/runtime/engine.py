@@ -362,6 +362,10 @@ class TPUEngine:
 
         with mesh:
             master = shard_like(params, self.param_specs)
+            if hasattr(self.optimizer, "configure_partitioning"):
+                # 1-bit optimizers lay their error-feedback buffers out per
+                # manual (pipe) shard — hand them the base param specs.
+                self.optimizer.configure_partitioning(self._base_specs, mesh)
             opt_state_host = self.optimizer.init(master)
             opt_specs_full = self._opt_state_specs(opt_state_host, params)
             self.opt_state_specs_full = opt_specs_full
@@ -589,9 +593,10 @@ class TPUEngine:
         """Spec tree for the optimizer state: any sub-tree that mirrors the
         param tree structure (moment trees) gets the ZeRO opt-state specs;
         everything else (step counters etc.) is replicated. Optimizers with
-        bespoke layouts (1-bit error buffers) provide ``state_specs``."""
+        bespoke layouts (1-bit error buffers) provide ``state_specs`` and
+        receive the engine's ZeRO opt-state specs for their moment trees."""
         if hasattr(self.optimizer, "state_specs"):
-            return self.optimizer.state_specs(params)
+            return self.optimizer.state_specs(params, opt_specs=self.opt_specs)
         params_structure = jax.tree_util.tree_structure(params)
 
         def specs_for(sub):
@@ -647,6 +652,11 @@ class TPUEngine:
 
     def _build_step_fns(self) -> None:
         if self._offload_cfg.enabled:
+            if getattr(self.optimizer, "needs_local_grads", False):
+                raise ConfigError(
+                    "1-bit optimizers cannot combine with offload_optimizer:"
+                    " the compressed sync needs rank-local grads on device, "
+                    "the offload tier moves the optimizer step to the host")
             self._build_offload_step_fns()
             return
         if getattr(self.optimizer, "needs_local_grads", False):
@@ -717,37 +727,17 @@ class TPUEngine:
         self._train_step = jax.jit(train_step, donate_argnums=donate)
         self._eval_step = jax.jit(eval_step)
 
-    def _build_local_grad_step_fns(self) -> None:
-        """Step functions for communication-efficient optimizers
-        (OneBitAdam/OneBitLamb, reference runtime/fp16/onebit/): the whole
-        fused step runs inside a shard_map manual over ``data`` so the
-        optimizer sees LOCAL (unreduced) gradients and performs its own
-        compressed collective — the engine's dense grad allreduce is
-        bypassed, exactly like the reference disables its own allreduce for
-        1-bit optimizers (onebit/adam.py:98). Restrictions: ZeRO stage 0,
-        ``train_batch()`` only (no per-microbatch forward/backward).
-        ``gradient_clipping`` applies inside the shard_map via a psum'd
-        rank-RMS norm (see below)."""
+    # -- local-grad (1-bit) path: overridable pieces -----------------------
+    def _local_grad_axes(self):
+        """(comp_axis, dense_axis, manual_axes): the compression axis (dcn
+        on hierarchical meshes, data otherwise) plus — when they differ —
+        the ICI-inner data axis, which the engine pre-reduces DENSELY before
+        the optimizer's compressed collective (cheap on ICI; the 1-bit
+        protocol saves the slow-axis bandwidth only, exactly the reference's
+        Ethernet-NCCL positioning, runtime/comm/nccl.py:47)."""
         from deepspeed_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
 
-        cfg = self.config
-        if cfg.zero_config.stage != 0:
-            raise ValueError("1-bit optimizers require ZeRO stage 0 "
-                             "(compressed comm replaces the grad allreduce)")
-        gas = cfg.gradient_accumulation_steps
-        fp16 = cfg.fp16.enabled
-        precision = self.precision
-        loss_fn = self.loss_fn
-        mesh = self.mesh
-        optimizer = self.optimizer
-        scaler = self.loss_scaler
-        # Manual axes: the compression axis (dcn on hierarchical meshes,
-        # data otherwise) plus — when they differ — the ICI-inner data
-        # axis, which the engine pre-reduces DENSELY before the optimizer's
-        # compressed collective (cheap on ICI; the 1-bit protocol saves the
-        # slow-axis bandwidth only, exactly the reference's Ethernet-NCCL
-        # positioning, runtime/comm/nccl.py:47).
-        comp_axis = getattr(optimizer, "axis", DATA_AXIS)
+        comp_axis = getattr(self.optimizer, "axis", DATA_AXIS)
         if self.dcn_size > 1 and comp_axis != DCN_AXIS:
             raise ValueError(
                 f"1-bit compression axis '{comp_axis}' on a hierarchical "
@@ -758,50 +748,116 @@ class TPUEngine:
         if comp_axis != DATA_AXIS and self.mesh.shape.get(DATA_AXIS, 1) > 1:
             dense_axis = DATA_AXIS
             manual_axes.add(DATA_AXIS)
-        red_axes = tuple(sorted(manual_axes))
+        return comp_axis, dense_axis, manual_axes
 
-        from jax import shard_map
+    def _local_grad_forward_backward(self, comp_axis, dense_axis):
+        """fwd/bwd producing rank-LOCAL accumulated grads. Returns
+        fn(compute_params, grad_acc, sub, scale, batches) ->
+        (grads fp32 unscaled, loss fp32 local-mean)."""
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        loss_fn = self.loss_fn
 
-        state_specs = jax.tree_util.tree_map(
-            lambda _: PartitionSpec(), self.state)
-        if hasattr(optimizer, "state_specs"):
-            state_specs = state_specs._replace(
-                opt_state=optimizer.state_specs(self.state.params))
-
-        def train_step_local(state: TrainState, batches, lr):
-            compute_params = precision.cast_params(state.params)
-
-            def body(st, batch):
-                rng, sub = jax.random.split(st.rng)
-                rank = jax.lax.axis_index(comp_axis)
-                if dense_axis is not None:
-                    rank = (rank * jax.lax.axis_size(dense_axis)
-                            + jax.lax.axis_index(dense_axis))
-                sub = jax.random.fold_in(sub, rank)
-                scale = st.loss_scale.scale if fp16 else jnp.float32(1.0)
+        def run(compute_params, grad_acc, sub, scale, batches):
+            def body(carry, batch):
+                acc, key = carry
+                key, k = jax.random.split(key)
 
                 def scaled(cp):
-                    out = loss_fn(cp, batch, sub)
+                    out = loss_fn(cp, batch, k)
                     loss = (out[0] if isinstance(out, tuple) else out)
                     loss32 = loss.astype(jnp.float32)
                     return loss32 * scale / gas, loss32
 
                 (_, loss), grads = jax.value_and_grad(
                     scaled, has_aux=True)(compute_params)
-                grads = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(a.dtype), st.grad_acc, grads)
-                return st._replace(micro_step=st.micro_step + 1,
-                                   grad_acc=grads, rng=rng), loss
+                acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(a.dtype), acc, grads)
+                return (acc, key), loss
 
-            state, losses = jax.lax.scan(body, state, batches)
-            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
+            (acc, _), losses = jax.lax.scan(body, (grad_acc, sub), batches)
             grads = jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32) / scale, state.grad_acc)
+                lambda g: g.astype(jnp.float32) / scale, acc)
+            return grads, jnp.mean(losses)
+
+        return run
+
+    def _local_grad_sq(self, grads):
+        """This rank's squared-norm contribution (overridden by the
+        pipeline engine to psum the pipe-sharded block part)."""
+        return global_norm(grads) ** 2
+
+    def _build_local_grad_step_fns(self) -> None:
+        """Step functions for communication-efficient optimizers
+        (OneBitAdam/OneBitLamb, reference runtime/fp16/onebit/), in two
+        phases: the fwd/bwd + compressed momentum sync run inside a
+        shard_map manual over the compression axes so the optimizer sees
+        LOCAL (unreduced) gradients and performs its own compressed
+        collective — the engine's dense grad allreduce is bypassed, exactly
+        like the reference disables its own allreduce for 1-bit optimizers
+        (onebit/adam.py:98) — and the elementwise optimizer apply runs in
+        GSPMD-auto mode, where ZeRO-1 optimizer-state sharding composes as
+        an ordinary placement policy. Restrictions: ZeRO stage 0/1,
+        ``train_batch()`` only (no per-microbatch forward/backward).
+        ``gradient_clipping`` applies inside the shard_map via a psum'd
+        rank-RMS norm (see below)."""
+        cfg = self.config
+        if cfg.zero_config.stage > 1:
+            raise ValueError(
+                "1-bit optimizers require ZeRO stage 0 or 1 (grad/param "
+                "sharding would break the rank-local compressed protocol; "
+                "compressed comm replaces the grad allreduce)")
+        gas = cfg.gradient_accumulation_steps
+        fp16 = cfg.fp16.enabled
+        precision = self.precision
+        mesh = self.mesh
+        optimizer = self.optimizer
+        scaler = self.loss_scaler
+        comp_axis, dense_axis, manual_axes = self._local_grad_axes()
+        # Axes the grad statistics reduce over (loss mean, clip norm): the
+        # data-like axes only; the pipeline's pipe axis shards *params*,
+        # not batch, and is handled by the fwd/bwd hook itself.
+        red_axes = tuple(sorted(a for a in manual_axes
+                                if a in (comp_axis, dense_axis)))
+        all_manual = tuple(sorted(manual_axes))
+
+        from jax import shard_map
+
+        params_tree = self.state.params
+        base_specs = self._base_specs
+        if base_specs is None:
+            base_specs = jax.tree_util.tree_map(
+                lambda _: PartitionSpec(), params_tree)
+
+        def manual_restrict(spec):
+            entries = []
+            for e in tuple(spec):
+                parts = e if isinstance(e, tuple) else (e,)
+                kept = tuple(a for a in parts if a in manual_axes)
+                entries.append(kept if len(kept) > 1
+                               else (kept[0] if kept else None))
+            return PartitionSpec(*entries)
+
+        param_in_specs = jax.tree_util.tree_map(manual_restrict, base_specs)
+        we_specs = self.opt_state_specs_full.worker_error
+        se_specs = self.opt_state_specs_full.server_error
+        fwd_bwd = self._local_grad_forward_backward(comp_axis, dense_axis)
+
+        def phase_a(params, grad_acc, m, we, se, step, sub, scale, batches):
+            compute_params = precision.cast_params(params)
+            rank = jax.lax.axis_index(comp_axis)
+            if dense_axis is not None:
+                rank = (rank * jax.lax.axis_size(dense_axis)
+                        + jax.lax.axis_index(dense_axis))
+            sub = jax.random.fold_in(sub, rank)
+            grads, loss = fwd_bwd(compute_params, grad_acc, sub, scale,
+                                  batches)
             if dense_axis is not None:
                 # Dense ICI-local reduction; the optimizer's compressed
                 # collective then runs over the slow axis only.
                 grads = jax.tree_util.tree_map(
                     lambda g: jax.lax.pmean(g, dense_axis), grads)
+            norm = jnp.float32(0.0)
             if cfg.gradient_clipping > 0.0:
                 # Global-norm clip BEFORE the optimizer's own collective
                 # (round-2 VERDICT weak #3: the reference composes 1-bit
@@ -810,9 +866,10 @@ class TPUEngine:
                 # is the rank-RMS proxy sqrt(mean_r ||g_r||^2): equal to
                 # the true averaged-grad norm when ranks agree, an upper
                 # bound otherwise — the same coefficient on every rank, so
-                # clipping commutes with the later pmean/compressed sync.
+                # clipping commutes with the later pmean/compressed sync
+                # (bias documented in docs/MIGRATING.md).
                 clip = cfg.gradient_clipping
-                local_sq = global_norm(grads) ** 2
+                local_sq = self._local_grad_sq(grads)
                 nr = 1
                 for ax in red_axes:
                     nr *= mesh.shape.get(ax, 1)
@@ -821,42 +878,73 @@ class TPUEngine:
                 grads = jax.tree_util.tree_map(lambda g: g * coef, grads)
             if fp16:
                 local_of = has_inf_or_nan(grads).astype(jnp.int32)
-                overflow = jax.lax.pmax(local_of, red_axes) > 0
+                overflow = jax.lax.pmax(local_of, all_manual) > 0
             else:
                 overflow = jnp.zeros((), jnp.bool_)
-            new_params, new_opt = optimizer.update(grads, state.opt_state,
-                                                   state.params, lr=lr)
-            new_params = _tree_where(overflow, state.params, new_params)
-            new_opt = _tree_where(overflow, state.opt_state, new_opt)
-            new_ls = scaler.update(state.loss_scale, overflow)
-            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
-            state = state._replace(
-                step=state.step + jnp.where(overflow, 0, 1),
-                params=new_params, opt_state=new_opt, grad_acc=zero_acc,
-                loss_scale=new_ls,
-                skipped_steps=state.skipped_steps + overflow.astype(jnp.int32))
-            loss_mean = jax.lax.pmean(jnp.mean(losses), red_axes)
-            return state, loss_mean, overflow, jnp.float32(0.0)
+            m_new, g_dense, we_new, se_new = optimizer.sync_phase(
+                grads, m, we, se, step)
+            loss_mean = jax.lax.pmean(loss, red_axes)
+            return loss_mean, m_new, g_dense, we_new, se_new, overflow, norm
 
         # Batch spec: honor the engine's batch_spec, keeping only the
         # manual (data-like) axes (other axes stay GSPMD-auto and may not
         # appear in the shard_map's specs).
-        def manual_only(entry):
-            parts = entry if isinstance(entry, tuple) else (entry,)
-            kept = tuple(a for a in parts if a in manual_axes)
-            return kept if len(kept) > 1 else (kept[0] if kept else None)
-
-        data_only = tuple(manual_only(a) for a in tuple(self.batch_spec))
-        batch_in_spec = PartitionSpec(None, *data_only)
+        batch_in_spec = PartitionSpec(
+            None, *tuple(manual_restrict(self.batch_spec)))
+        rep = PartitionSpec()
         mapped = shard_map(
-            train_step_local, mesh=mesh,
-            in_specs=(state_specs, batch_in_spec, PartitionSpec()),
-            out_specs=(state_specs, PartitionSpec(), PartitionSpec(),
-                       PartitionSpec()),
+            phase_a, mesh=mesh,
+            in_specs=(param_in_specs, param_in_specs, param_in_specs,
+                      we_specs, se_specs, rep, rep, rep, batch_in_spec),
+            out_specs=(rep, param_in_specs, param_in_specs, we_specs,
+                       se_specs, rep, rep),
             axis_names=manual_axes,
             check_vma=False)
+
+        opt_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self.opt_state_specs_full)
+        param_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self.param_specs)
+        grad_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self.grad_specs)
+
+        def train_step(state: TrainState, batches, lr):
+            rng, sub = jax.random.split(state.rng)
+            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
+            opt = state.opt_state
+            loss, m_new, g_dense, we_new, se_new, overflow, norm = mapped(
+                state.params, state.grad_acc, opt.m, opt.worker_error,
+                opt.server_error, opt.step, sub, scale, batches)
+            # GSPMD-auto apply: ZeRO-1 places m/v sharded (opt_specs); the
+            # resulting gather/slice collectives ride the ICI data axis.
+            new_params, new_opt = optimizer.finish_step(
+                state.params, opt, m_new, g_dense, we_new, se_new, lr)
+            new_params = _tree_where(overflow, state.params, new_params)
+            new_opt = _tree_where(overflow, opt, new_opt)
+            new_params = jax.lax.with_sharding_constraint(
+                new_params, param_shardings)
+            new_opt = jax.lax.with_sharding_constraint(new_opt, opt_shardings)
+            new_ls = scaler.update(state.loss_scale, overflow)
+            zero_acc = jax.lax.with_sharding_constraint(
+                jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc),
+                grad_shardings)
+            state = state._replace(
+                step=state.step + jnp.where(overflow, 0, 1),
+                micro_step=state.micro_step + gas,
+                params=new_params, opt_state=new_opt, grad_acc=zero_acc,
+                loss_scale=new_ls, rng=rng,
+                skipped_steps=state.skipped_steps + overflow.astype(jnp.int32))
+            return state, loss, overflow, norm
+
         donate = (0,) if self._donate else ()
-        self._train_step = jax.jit(mapped, donate_argnums=donate)
+        self._train_step = jax.jit(train_step, donate_argnums=donate)
+        self._eval_step = self._make_local_grad_eval_step()
+        self._micro_step = None
+        self._apply_step = None
+
+    def _make_local_grad_eval_step(self):
+        loss_fn = self.loss_fn
+        precision = self.precision
 
         def eval_step(state: TrainState, batch):
             compute_params = precision.cast_params(state.params)
@@ -864,9 +952,7 @@ class TPUEngine:
             loss, aux = (out if isinstance(out, tuple) else (out, None))
             return loss.astype(jnp.float32), aux
 
-        self._eval_step = jax.jit(eval_step)
-        self._micro_step = None
-        self._apply_step = None
+        return jax.jit(eval_step)
 
     # ------------------------------------------------------------------
     # Public API (reference parity: engine(batch) / backward / step)

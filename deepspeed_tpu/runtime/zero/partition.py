@@ -178,10 +178,21 @@ def estimate_zero_model_states_mem_needs(total_params: int,
                                          param_dtype_bytes: int = 2,
                                          master_dtype_bytes: int = 4,
                                          optim_states_per_param: int = 2):
-    """Per-device HBM and host bytes for model states under a ZeRO stage.
+    """Per-device HBM and host bytes for model states under a ZeRO stage
+    (reference ``estimate_zero2_model_states_mem_needs`` stage2.py:2005 and
+    ``estimate_zero3_model_states_mem_needs`` stage3.py:3272, re-framed
+    per-device for the placement-policy design).
 
     Model states = params (bf16) + grads (bf16/fp32) + master params (fp32)
     + optimizer moments (2×fp32 for Adam).
+
+    ``cpu_offload`` models this engine's offload tiers: the fp32
+    master+moments move to host, sharded over devices for stage >= 1 and
+    FULL per host at stage 0 (no ZeRO sharding to exploit). At stage 3 the
+    offload_optimizer tier requires offload_param (runtime/engine.py), so
+    the compute-dtype param partition leaves HBM too — the reference's
+    18-vs-16-bytes/param distinction between its zero-3 offload_params and
+    zero-2 offload estimates.
     """
     gb = 1024**3
     p = total_params
@@ -190,17 +201,20 @@ def estimate_zero_model_states_mem_needs(total_params: int,
     params = param_dtype_bytes * p
     if stage == 0:
         hbm = params + grads + master_and_optim
-        host = 0
     elif stage == 1:
         hbm = params + grads + master_and_optim / num_devices
-        host = 0
     elif stage == 2:
         hbm = params + (grads + master_and_optim) / num_devices
-        host = 0
     else:
         hbm = (params + grads + master_and_optim) / num_devices
-        host = 0
+    host = 0
     if cpu_offload:
-        host = master_and_optim / num_devices if stage < 3 else master_and_optim / num_devices
-        hbm -= master_and_optim / num_devices
+        opt_shard = num_devices if stage >= 1 else 1
+        host = master_and_optim / opt_shard
+        hbm -= master_and_optim / opt_shard
+        if stage == 3:
+            # offload_param: the bf16 param partition lives host-side and
+            # streams on demand (runtime/zero/param_offload.py).
+            host += params / num_devices
+            hbm -= params / num_devices
     return {"hbm_gb": hbm / gb, "host_gb": host / gb}

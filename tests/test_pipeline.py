@@ -179,6 +179,113 @@ class TestPipelineEngine:
         assert abs(l1 - l2) < 1e-6
 
 
+class TestPipelineOneBit:
+    """Pipeline × ZeRO-0/1 × 1-bit Adam — the BASELINE ladder's final rung
+    (GPT-2 1.5B "Pipeline + ZeRO-1 + 1-bit Adam"; round-3 VERDICT task 1).
+    The reference composes 1-bit Adam with its engines by switching comm
+    paths (deepspeed/runtime/fp16/onebit/adam.py:92-104); here the
+    PipelineEngine extends the two-phase local-grad path with the pipe
+    axis (parallel/pipe/engine.py)."""
+
+    def _make(self, stages=2, zero_stage=1, gas=4, layers=4, data=None,
+              opt="OneBitAdam", freeze_step=100, lr=1e-3, tie=True):
+        cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=32,
+                        num_layers=layers, num_heads=2, dropout_rate=0.0,
+                        dtype=jnp.float32, tie_embeddings=tie)
+        pm = gpt_pipe_model(cfg)
+        data = (8 // stages) if data is None else data
+        mesh = build_mesh(data=data, pipe=stages,
+                          devices=jax.devices()[:data * stages])
+        ds = DeepSpeedTPUConfig({
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": opt,
+                          "params": ({"lr": lr, "freeze_step": freeze_step}
+                                     if opt.startswith("OneBit")
+                                     else {"lr": lr})},
+            "zero_optimization": {"stage": zero_stage},
+        })
+        return PipelineEngine(pm, ds, mesh=mesh), cfg
+
+    def _batches(self, rng, cfg, gas, mb=8, seq=16):
+        return {"input_ids": rng.integers(
+            0, cfg.vocab_size, (gas, mb, seq), dtype=np.int32)}
+
+    def test_trains_through_both_phases(self, eight_devices):
+        engine, cfg = self._make(stages=2, zero_stage=1, freeze_step=3)
+        rng = np.random.default_rng(0)
+        batches = self._batches(rng, cfg, engine.micro_batches)
+        losses = [float(engine.train_batch(batches)) for _ in range(12)]
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0] - 0.3, losses
+        # still improving after the freeze -> compressed sync works under pp
+        assert losses[-1] < losses[5] - 0.02, losses
+
+    def test_warmup_matches_dense_adam(self, eight_devices):
+        """During warmup 1-bit Adam IS dense Adam (same update formula as
+        FusedAdam with wd=0) — the pipelined local-grad path must reproduce
+        the dense pipeline engine's trajectory."""
+        rng = np.random.default_rng(1)
+        e_1bit, cfg = self._make(stages=2, zero_stage=1, freeze_step=100)
+        batches = self._batches(rng, cfg, e_1bit.micro_batches)
+        e_dense, _ = self._make(stages=2, zero_stage=1, opt="Adam")
+        l_1bit = [float(e_1bit.train_batch(batches)) for _ in range(5)]
+        l_dense = [float(e_dense.train_batch(batches)) for _ in range(5)]
+        np.testing.assert_allclose(l_1bit, l_dense, rtol=2e-4, atol=2e-4)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4),
+            e_1bit.state.params, e_dense.state.params)
+
+    def test_matches_single_stage(self, eight_devices):
+        """pipe=2 vs pipe=1 with the SAME data-axis size (n=4): identical
+        compression semantics, so the trajectories must match through BOTH
+        phases — exercises the psum-over-pipe gradient fix-up, incl. tied
+        embeddings (wte grads combine rank-0 embed + rank-1 head parts)."""
+        rng = np.random.default_rng(2)
+        e_pipe, cfg = self._make(stages=2, data=4, freeze_step=2)
+        batches = self._batches(rng, cfg, e_pipe.micro_batches)
+        e_seq, _ = self._make(stages=1, data=4, freeze_step=2)
+        l_pipe = [float(e_pipe.train_batch(batches)) for _ in range(6)]
+        l_seq = [float(e_seq.train_batch(batches)) for _ in range(6)]
+        np.testing.assert_allclose(l_pipe, l_seq, atol=2e-3, rtol=2e-3)
+
+    def test_untied_matches_single_stage(self, eight_devices):
+        rng = np.random.default_rng(3)
+        e_pipe, cfg = self._make(stages=2, data=4, freeze_step=2, tie=False)
+        batches = self._batches(rng, cfg, e_pipe.micro_batches)
+        e_seq, _ = self._make(stages=1, data=4, freeze_step=2, tie=False)
+        l_pipe = [float(e_pipe.train_batch(batches)) for _ in range(5)]
+        l_seq = [float(e_seq.train_batch(batches)) for _ in range(5)]
+        np.testing.assert_allclose(l_pipe, l_seq, atol=2e-3, rtol=2e-3)
+
+    def test_zero1_matches_zero0(self, eight_devices):
+        """ZeRO-1 under the pipelined 1-bit path is placement-only."""
+        rng = np.random.default_rng(4)
+        e_z1, cfg = self._make(stages=2, zero_stage=1, freeze_step=2)
+        batches = self._batches(rng, cfg, e_z1.micro_batches)
+        e_z0, _ = self._make(stages=2, zero_stage=0, freeze_step=2)
+        l_z1 = [float(e_z1.train_batch(batches)) for _ in range(5)]
+        l_z0 = [float(e_z0.train_batch(batches)) for _ in range(5)]
+        np.testing.assert_allclose(l_z1, l_z0, rtol=1e-5)
+
+    def test_onebit_lamb_trains(self, eight_devices):
+        engine, cfg = self._make(stages=2, zero_stage=1, opt="OneBitLamb",
+                                 freeze_step=3, lr=2e-2)
+        rng = np.random.default_rng(5)
+        batches = self._batches(rng, cfg, engine.micro_batches)
+        losses = [float(engine.train_batch(batches)) for _ in range(10)]
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0] - 0.2, losses
+
+    def test_eval_batch_works(self, eight_devices):
+        engine, cfg = self._make(stages=2, zero_stage=1)
+        rng = np.random.default_rng(6)
+        batches = self._batches(rng, cfg, engine.micro_batches)
+        engine.train_batch(batches)
+        assert np.isfinite(float(engine.eval_batch(batches)))
+
+
 class TestPipelineComputeAccounting:
     def test_per_device_compute_matches_bubble_theory(self, eight_devices):
         """Per-device executed compute must equal the GPipe/1F1B bubble

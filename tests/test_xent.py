@@ -115,3 +115,38 @@ class TestFusedXent:
                      if hasattr(var, "aval")]
         big = [s for s in res_sizes if s >= n * v]
         assert not big, f"[N,V]-sized residuals saved: {res_sizes}"
+
+
+class TestFp32LogitsMode:
+    """logits_fp32=True (ADVICE r3): bf16 inputs must reproduce the unfused
+    fp32-logits path EXACTLY — no bf16 rounding of the logits before the
+    logsumexp — while the default mode is allowed to differ."""
+
+    def test_bf16_exact_parity_with_unfused(self):
+        rng = np.random.default_rng(0)
+        x, w, labels = _data(rng)
+        xb, wb = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+
+        def unfused(xb, wb):
+            logits = jnp.einsum("nd,vd->nv", xb, wb,
+                                preferred_element_type=jnp.float32)
+            return cross_entropy_with_ignore(logits, labels)
+
+        def fused32(xb, wb):
+            return fused_cross_entropy(xb, wb, labels, logits_fp32=True)
+
+        l_ref, g_ref = jax.value_and_grad(unfused, argnums=(0, 1))(xb, wb)
+        l_f32, g_f32 = jax.value_and_grad(fused32, argnums=(0, 1))(xb, wb)
+        np.testing.assert_allclose(float(l_ref), float(l_f32), rtol=1e-6)
+        for a, b in zip(g_ref, g_f32):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_default_mode_unchanged(self):
+        rng = np.random.default_rng(1)
+        x, w, labels = _data(rng)
+        l_def = fused_cross_entropy(x, w, labels)
+        l_32 = fused_cross_entropy(x, w, labels, logits_fp32=True)
+        # fp32 inputs: both modes identical
+        np.testing.assert_allclose(float(l_def), float(l_32), rtol=1e-6)
