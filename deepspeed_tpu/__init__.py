@@ -57,6 +57,35 @@ def initialize(args=None,
     if dist_init_required:
         init_distributed()
 
+    if cfg.sparse_attention:
+        # Config-driven sparse-attention surgery (reference applies
+        # BertSparseSelfAttention via SparseAttentionUtils; here the
+        # in-tree families route attention by config, so the swap is a
+        # frozen-dataclass replace — parameter-free).
+        if model is not None and hasattr(model, "cfg") \
+                and hasattr(model.cfg, "sparse_attention"):
+            if getattr(model.cfg, "sparse_attention") != cfg.sparse_attention:
+                from deepspeed_tpu.ops.sparse_attention.utils import \
+                    SparseAttentionUtils
+                model = (SparseAttentionUtils.
+                         replace_model_self_attention_with_sparse_self_attention(
+                             model, cfg.sparse_attention))
+                from deepspeed_tpu.utils.logging import log_dist
+                log_dist(f"sparse_attention: routed {type(model).__name__} "
+                         f"attention through mode="
+                         f"{cfg.sparse_attention.get('mode', 'fixed')}",
+                         ranks=[0])
+        else:
+            # Custom module or loss_fn entry: no surgery possible — same
+            # contract for both entry styles (the user's code must route
+            # attention through ops.sparse_attention.SparseSelfAttention).
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                "sparse_attention config block with a custom model/loss_fn:"
+                " no surgery applied — route attention through "
+                "ops.sparse_attention.SparseSelfAttention yourself "
+                "(see ops/sparse_attention/utils.py)")
+
     if cfg.zero_config.offload_param.enabled and loss_fn is not None:
         raise ValueError(
             "offload_param cannot stream an opaque loss_fn (no per-block "
@@ -95,8 +124,24 @@ def initialize(args=None,
                         "in-tree GPT; opaque modules/loss_fns have no "
                         "per-block fetch points")
             # `params` (if given) may be pipe layout OR an already-packed
-            # tree restored from an offload checkpoint.
-            loss_fn, params = build_streamed_loss(pm, params=params)
+            # tree restored from an offload checkpoint. With an explicit
+            # mesh whose model axis > 1, TP specs are derived from the
+            # in-tree partition rules and the packing becomes shard-aligned
+            # (ZeRO-Infinity x MP; runtime/zero/param_offload.pack_blocks_tp).
+            tp_specs = None
+            if mesh is not None and any(
+                    mesh.shape.get(a, 1) > 1
+                    for a in ("model", "sequence")):
+                from deepspeed_tpu.models import (build_specs,
+                                                  gpt_partition_rules)
+
+                one_block = _jax.tree_util.tree_map(
+                    lambda x: x[0], pm.params["blocks"])
+                tp_specs = build_specs(one_block, gpt_partition_rules(),
+                                       mesh_axes=dict(mesh.shape))
+            loss_fn, params = build_streamed_loss(pm, params=params,
+                                                  tp_specs=tp_specs,
+                                                  mesh=mesh)
     if loss_fn is None:
         if model is None:
             raise ValueError("initialize() needs either loss_fn+params or model")

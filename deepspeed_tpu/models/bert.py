@@ -18,6 +18,10 @@ from deepspeed_tpu.models.gpt import cross_entropy_with_ignore
 from deepspeed_tpu.ops.transformer.attention import attention
 
 
+
+
+from deepspeed_tpu.ops.dropout import dropout_module as _dropout_mod
+
 @dataclass(frozen=True)
 class BertConfig:
     vocab_size: int = 30522
@@ -37,6 +41,12 @@ class BertConfig:
     # exact fp32-logits numerics inside the fused CE (parity-sensitive
     # bf16 runs; costs the fp32 [N,V] HBM pass the fused op avoids)
     fused_ce_fp32_logits: bool = False
+    # Block-sparse attention config dict (the DeepSpeed `sparse_attention`
+    # block); deepspeed_tpu.initialize() injects it from the engine config.
+    # The reference's BertSparseSelfAttention surgery, as a config field.
+    sparse_attention: Any = None
+    # Counter-hash activation dropout (ops/dropout.py) — see GPTConfig.
+    fast_dropout: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -68,18 +78,34 @@ class BertLayer(nn.Module):
             q, k, v = jnp.split(qkv, 3, axis=-1)
             b, s = q.shape[0], q.shape[1]
             shape = (b, s, cfg.num_heads, cfg.head_dim)
-            o = attention(q.reshape(shape), k.reshape(shape), v.reshape(shape),
-                          causal=False, mask=attn_mask,
-                          dropout_rate=cfg.dropout_rate, dropout_rng=drop_rng,
-                          deterministic=deterministic, impl=cfg.attention_impl)
+            if cfg.sparse_attention is not None:
+                # Config-driven block-sparse path — the BertSparseSelfAttention
+                # analogue (reference sparse_attention_utils.py:100).
+                from deepspeed_tpu.ops.sparse_attention.utils import \
+                    get_sparse_self_attention
+
+                ssa = get_sparse_self_attention(cfg.sparse_attention,
+                                                cfg.num_heads)
+                km = (attn_mask[:, 0, 0, :]
+                      if attn_mask is not None else None)
+                o = ssa(q.reshape(shape), k.reshape(shape),
+                        v.reshape(shape), causal=False, key_mask=km)
+            else:
+                o = attention(q.reshape(shape), k.reshape(shape),
+                              v.reshape(shape),
+                              causal=False, mask=attn_mask,
+                              dropout_rate=cfg.dropout_rate,
+                              dropout_rng=drop_rng,
+                              deterministic=deterministic,
+                              impl=cfg.attention_impl)
             o = nn.Dense(d, dtype=dt, name="c_proj")(o.reshape(b, s, d))
-            return nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(o)
+            return _dropout_mod(cfg)(cfg.dropout_rate, deterministic=deterministic)(o)
 
         def mlp(h):
             h = nn.Dense(cfg.mlp_ratio * d, dtype=dt, name="c_fc")(h)
             h = nn.gelu(h, approximate=True)
             h = nn.Dense(d, dtype=dt, name="mlp_proj")(h)
-            return nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+            return _dropout_mod(cfg)(cfg.dropout_rate, deterministic=deterministic)(h)
 
         if cfg.pre_layer_norm:
             x = x + attn(ln("ln_attn")(x).astype(dt))
@@ -117,7 +143,7 @@ class BertModel(nn.Module):
         if not cfg.pre_layer_norm:
             x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
                              name="ln_emb")(x).astype(cfg.dtype)
-        x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
+        x = _dropout_mod(cfg)(cfg.dropout_rate, deterministic=deterministic)(x)
 
         attn_mask = None
         am = batch.get("attention_mask")

@@ -30,6 +30,9 @@ from deepspeed_tpu.ops.transformer.attention import attention
 from deepspeed_tpu.ops.xent import fused_cross_entropy
 
 
+from deepspeed_tpu.ops.dropout import dropout_module as _dropout_mod
+
+
 @dataclass(frozen=True)
 class GPTConfig:
     vocab_size: int = 50257
@@ -50,6 +53,26 @@ class GPTConfig:
     fused_ce_fp32_logits: bool = False
     # None -> 1/sqrt(head_dim); GPT-Neo trains UNSCALED attention (1.0)
     attention_scale: Any = None
+    # MXU tiling lever (PROFILE.md r3): pad the wte vocab dim to a multiple
+    # (128 pads GPT-2's 50257 -> 50304) so the tied head matmul tiles
+    # exactly; pad logits are masked to -1e9 in the CE, so the loss is
+    # numerically identical to the unpadded model and pad rows stay at
+    # init. 0 = off. Applies to the tied-embedding head (lm_head stays
+    # unpadded when untied).
+    vocab_pad_multiple: int = 0
+    # Embedding-table gradient via one-hot MXU matmul instead of XLA's
+    # serialized TPU scatter-add (ops/embedding.py; PROFILE.md r3 lever).
+    embed_grad_matmul: bool = False
+    # Counter-hash activation dropout (ops/dropout.py) instead of flax's
+    # threefry bernoulli — the reference's fused-dropout economy
+    # (csrc/transformer/dropout_kernels.cu); measured A/B in PROFILE.md.
+    fast_dropout: bool = True
+    # Block-sparse attention config dict (the DeepSpeed `sparse_attention`
+    # block: mode/block/num_local_blocks/...). When set, training attention
+    # routes through ops.sparse_attention (long-sequence O(s·√s) path);
+    # decode (kv_cache) stays dense. deepspeed_tpu.initialize() injects
+    # this from the engine config automatically.
+    sparse_attention: Any = None
     # MoE-GPT (the GShard/Switch "every other layer is MoE" family): with
     # moe_experts > 0, every moe_layer_freq-th block's FFN becomes a
     # deepspeed_tpu.moe.MoE layer (expert-parallel via moe_partition_rules)
@@ -63,6 +86,13 @@ class GPTConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def padded_vocab(self) -> int:
+        m = self.vocab_pad_multiple
+        if m <= 1:
+            return self.vocab_size
+        return (self.vocab_size + m - 1) // m * m
 
     @property
     def num_params(self) -> int:
@@ -133,6 +163,22 @@ class GPTBlock(nn.Module):
             o = attention(q, ck, cv, causal=False, mask=dec_mask,
                           deterministic=True, impl="xla",
                           softmax_scale=cfg.attention_scale)
+        elif cfg.sparse_attention is not None:
+            # Config-driven block-sparse path (reference
+            # sparse_attention_utils.py model surgery). Attention-prob
+            # dropout is not applied under the sparse executor (the
+            # reference's sparse path likewise has none); residual/MLP
+            # dropouts still apply.
+            from deepspeed_tpu.ops.sparse_attention.utils import \
+                get_sparse_self_attention
+
+            ssa = get_sparse_self_attention(cfg.sparse_attention,
+                                            cfg.num_heads)
+            km = None
+            if attn_mask is not None:
+                km = attn_mask[:, 0, 0, :]   # [B,1,1,S] -> [B,S] key mask
+            o = ssa(q, k, v, causal=True, key_mask=km,
+                    softmax_scale=cfg.attention_scale)
         else:
             o = attention(q, k, v, causal=True, mask=attn_mask,
                           dropout_rate=cfg.dropout_rate, dropout_rng=drop_rng,
@@ -140,7 +186,7 @@ class GPTBlock(nn.Module):
                           softmax_scale=cfg.attention_scale)
         o = o.reshape(b, s, d)
         o = nn.Dense(d, dtype=dt, name="c_proj")(o)
-        o = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(o)
+        o = _dropout_mod(cfg)(cfg.dropout_rate, deterministic=deterministic)(o)
         x = x + o
 
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
@@ -158,7 +204,7 @@ class GPTBlock(nn.Module):
             h = nn.Dense(cfg.mlp_ratio * d, dtype=dt, name="c_fc")(h)
             h = nn.gelu(h, approximate=True)
             h = nn.Dense(d, dtype=dt, name="mlp_proj")(h)
-        h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+        h = _dropout_mod(cfg)(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
         out = (x, kv_cache) if kv_cache is not None else x
         if self.moe:
@@ -189,7 +235,7 @@ class GPT(nn.Module):
         ids = batch["input_ids"]
         b, s = ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
-                         (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+                         (cfg.padded_vocab, cfg.hidden_size), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
         pos_ids = batch.get("position_ids") if isinstance(batch, dict) else None
@@ -201,8 +247,10 @@ class GPT(nn.Module):
             pe = wpe[:s][None]
         else:
             pe = jnp.take(wpe, pos + jnp.arange(s), axis=0)[None]
-        x = wte[ids].astype(cfg.dtype) + pe.astype(cfg.dtype)
-        x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
+        from deepspeed_tpu.ops.embedding import embedding_lookup
+        tok = embedding_lookup(wte, ids, matmul_grad=cfg.embed_grad_matmul)
+        x = tok.astype(cfg.dtype) + pe.astype(cfg.dtype)
+        x = _dropout_mod(cfg)(cfg.dropout_rate, deterministic=deterministic)(x)
 
         attn_mask = None
         if "attention_mask" in batch and batch["attention_mask"] is not None:
@@ -270,6 +318,8 @@ class GPT(nn.Module):
             logits = jnp.einsum("bsd,vd->bsv", x.astype(cfg.dtype),
                                 wte.astype(cfg.dtype),
                                 preferred_element_type=jnp.float32)
+            if cfg.padded_vocab != cfg.vocab_size:
+                logits = logits[..., :cfg.vocab_size]
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               name="lm_head")(x.astype(cfg.dtype)).astype(jnp.float32)
@@ -286,8 +336,12 @@ class GPT(nn.Module):
         # can't CSE; acceptable for eval loops, free for training.)
         labels = shift_labels(batch)
         if cfg.tie_embeddings and cfg.fused_ce:
+            from deepspeed_tpu.ops.embedding import vocab_pad_mask
+            mask = (vocab_pad_mask(cfg.padded_vocab, cfg.vocab_size)
+                    if cfg.padded_vocab != cfg.vocab_size else None)
             loss = fused_cross_entropy(x.astype(cfg.dtype),
                                        wte.astype(cfg.dtype), labels,
+                                       bias=mask, bias_grad=False,
                                        logits_fp32=cfg.fused_ce_fp32_logits)
         else:
             loss = cross_entropy_with_ignore(logits, labels)

@@ -1,0 +1,286 @@
+"""Megatron-LM layer injection — checkpoint import, MP resharding, revert.
+
+Reference: ``deepspeed/module_inject/replace_policy.py:146``
+(MegatronLayerPolicy reads ``attention.query_key_value`` /
+``mlp.dense_h_to_4h`` off a live ParallelTransformerLayer) and the Megatron
+checkpoint loader ``deepspeed/runtime/state_dict_factory.py:199`` (merge /
+split / reshard across MP degrees with special qkv handling). The revert
+direction mirrors ``replace_module.py:310`` (restoring the original module
+layout).
+
+TPU-native framing: a Megatron-trained GPT is a WEIGHT-LAYOUT away from the
+in-tree GPT family — torch ``[out, in]`` Linear kernels transpose to flax
+``[in, out]``, the fused qkv keeps its ``[q; k; v]`` column order (version
+>= 1; version 0's per-head interleaving is de-interleaved), and LayerNorm
+``weight``/``bias`` become ``scale``/``bias``. Per-MP-rank checkpoint
+shards merge through the same declarative rules as
+``runtime/state_dict_factory`` before conversion; serving at a new MP
+degree is then ``init_inference(mp_size=N)`` — GSPMD re-partitions, no
+per-rank files needed.
+"""
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.runtime.state_dict_factory import (_merge_qkv,
+                                                      merge_mp_checkpoints)
+
+_STRIP_PREFIXES = ("model.", "module.", "language_model.", "encoder.",
+                   "transformer.")
+
+
+def megatron_mp_rules() -> Tuple[Tuple[str, Optional[Tuple[str, int]]], ...]:
+    """MP merge rules over DOTTED Megatron state-dict keys (torch layout:
+    Linear weights [out, in]): column-parallel qkv/h_to_4h shard dim 0,
+    row-parallel dense/4h_to_h shard dim 1, embeddings shard the vocab."""
+    return (
+        (r"query_key_value\.weight$", ("qkv", 0)),
+        (r"query_key_value\.bias$", ("qkv", 0)),
+        (r"dense_h_to_4h\.weight$", ("cat", 0)),
+        (r"dense_h_to_4h\.bias$", ("cat", 0)),
+        (r"(attention|self_attention)\.dense\.weight$", ("cat", 1)),
+        (r"dense_4h_to_h\.weight$", ("cat", 1)),
+        (r"word_embeddings\.weight$", ("cat", 0)),
+        (r".*", None),
+    )
+
+
+def normalize_megatron_keys(sd: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Strip wrapper prefixes (``model.``/``language_model.``/...) so layer
+    keys start at ``layers.N`` / ``embedding`` / ``final_layernorm``."""
+    out = {}
+    for k, v in sd.items():
+        changed = True
+        while changed:
+            changed = False
+            for p in _STRIP_PREFIXES:
+                if k.startswith(p):
+                    k = k[len(p):]
+                    changed = True
+        out[k] = np.asarray(v)
+    return out
+
+
+def _deinterleave_qkv_v0(w: np.ndarray, num_heads: int) -> np.ndarray:
+    """Megatron version-0 checkpoints store qkv rows per-head interleaved
+    ([h0q, h0k, h0v, h1q, ...]); reorder to the global [q; k; v] layout."""
+    three_d = w.shape[0]
+    hd = three_d // (3 * num_heads)
+    rest = w.shape[1:]
+    return (w.reshape(num_heads, 3, hd, *rest)
+            .transpose(1, 0, 2, *range(3, 3 + len(rest)))
+            .reshape(three_d, *rest))
+
+
+def _interleave_qkv_v0(w: np.ndarray, num_heads: int) -> np.ndarray:
+    three_d = w.shape[0]
+    hd = three_d // (3 * num_heads)
+    rest = w.shape[1:]
+    return (w.reshape(3, num_heads, hd, *rest)
+            .transpose(1, 0, 2, *range(3, 3 + len(rest)))
+            .reshape(three_d, *rest))
+
+
+class MegatronLayerPolicy:
+    """Megatron-GPT state dict → in-tree GPT family (the reference policy's
+    weight extraction, applied to checkpoints instead of live modules)."""
+
+    model_type = "megatron"
+    version = 1    # >=1: [q;k;v] fused rows; 0: per-head interleaved
+
+    @staticmethod
+    def applies(model) -> bool:
+        # Megatron models arrive as checkpoints, not flax modules — the
+        # entry point is convert_megatron_checkpoint / load_megatron.
+        return False
+
+    @staticmethod
+    def convert(sd: Dict[str, np.ndarray], num_heads: int,
+                max_seq_len: Optional[int] = None, version: int = 1,
+                layer_norm_epsilon: float = 1e-5, dtype: Any = None):
+        """One (merged) Megatron state dict → (GPT module, params)."""
+        from deepspeed_tpu.models.gpt import GPT, GPTConfig
+
+        sd = normalize_megatron_keys(sd)
+        wte = sd["embedding.word_embeddings.weight"]
+        wpe = sd["embedding.position_embeddings.weight"]
+        layer_ids = sorted({int(m.group(1)) for k in sd
+                            for m in [re.match(r"layers\.(\d+)\.", k)] if m})
+        if layer_ids != list(range(len(layer_ids))):
+            raise ValueError(f"non-contiguous Megatron layers {layer_ids}")
+        vocab, d = wte.shape
+        kw = {} if dtype is None else {"dtype": dtype}
+        cfg = GPTConfig(vocab_size=int(vocab),
+                        max_seq_len=int(max_seq_len or wpe.shape[0]),
+                        hidden_size=int(d), num_layers=len(layer_ids),
+                        num_heads=int(num_heads), dropout_rate=0.0,
+                        layer_norm_epsilon=float(layer_norm_epsilon),
+                        tie_embeddings=True, **kw)
+
+        def ln(prefix):
+            return {"scale": sd[prefix + ".weight"],
+                    "bias": sd[prefix + ".bias"]}
+
+        params: Dict[str, Any] = {
+            "wte": wte, "wpe": wpe, "ln_f": ln("final_layernorm")}
+        for i in layer_ids:
+            p = f"layers.{i}."
+            attn = ("self_attention" if p + "self_attention.dense.weight"
+                    in sd else "attention")
+            qkv_w = sd[p + f"{attn}.query_key_value.weight"]
+            qkv_b = sd[p + f"{attn}.query_key_value.bias"]
+            if version == 0:
+                qkv_w = _deinterleave_qkv_v0(qkv_w, num_heads)
+                qkv_b = _deinterleave_qkv_v0(qkv_b, num_heads)
+            params[f"h_{i}"] = {
+                "ln_1": ln(p + "input_layernorm"),
+                "ln_2": ln(p + "post_attention_layernorm"),
+                "c_attn": {"kernel": qkv_w.T, "bias": qkv_b},
+                "c_proj": {"kernel": sd[p + f"{attn}.dense.weight"].T,
+                           "bias": sd[p + f"{attn}.dense.bias"]},
+                "c_fc": {"kernel": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                         "bias": sd[p + "mlp.dense_h_to_4h.bias"]},
+                "mlp_proj": {"kernel": sd[p + "mlp.dense_4h_to_h.weight"].T,
+                             "bias": sd[p + "mlp.dense_4h_to_h.bias"]},
+            }
+        return GPT(cfg), params
+
+    @staticmethod
+    def revert(params: Dict[str, Any], num_heads: int,
+               version: int = 1) -> Dict[str, np.ndarray]:
+        """In-tree GPT params → Megatron state-dict layout (the reference's
+        revert direction, replace_module.py:310) — exact inverse of
+        ``convert``, so round-trips are bit-equal."""
+        sd: Dict[str, np.ndarray] = {
+            "embedding.word_embeddings.weight": np.asarray(params["wte"]),
+            "embedding.position_embeddings.weight":
+                np.asarray(params["wpe"]),
+            "final_layernorm.weight": np.asarray(params["ln_f"]["scale"]),
+            "final_layernorm.bias": np.asarray(params["ln_f"]["bias"]),
+        }
+        attn = "self_attention" if version >= 1 else "attention"
+        i = 0
+        while f"h_{i}" in params:
+            h = params[f"h_{i}"]
+            p = f"layers.{i}."
+            qkv_w = np.asarray(h["c_attn"]["kernel"]).T
+            qkv_b = np.asarray(h["c_attn"]["bias"])
+            if version == 0:
+                qkv_w = _interleave_qkv_v0(qkv_w, num_heads)
+                qkv_b = _interleave_qkv_v0(qkv_b, num_heads)
+            sd[p + "input_layernorm.weight"] = np.asarray(h["ln_1"]["scale"])
+            sd[p + "input_layernorm.bias"] = np.asarray(h["ln_1"]["bias"])
+            sd[p + "post_attention_layernorm.weight"] = \
+                np.asarray(h["ln_2"]["scale"])
+            sd[p + "post_attention_layernorm.bias"] = \
+                np.asarray(h["ln_2"]["bias"])
+            sd[p + f"{attn}.query_key_value.weight"] = qkv_w
+            sd[p + f"{attn}.query_key_value.bias"] = qkv_b
+            sd[p + f"{attn}.dense.weight"] = \
+                np.asarray(h["c_proj"]["kernel"]).T
+            sd[p + f"{attn}.dense.bias"] = np.asarray(h["c_proj"]["bias"])
+            sd[p + "mlp.dense_h_to_4h.weight"] = \
+                np.asarray(h["c_fc"]["kernel"]).T
+            sd[p + "mlp.dense_h_to_4h.bias"] = np.asarray(h["c_fc"]["bias"])
+            sd[p + "mlp.dense_4h_to_h.weight"] = \
+                np.asarray(h["mlp_proj"]["kernel"]).T
+            sd[p + "mlp.dense_4h_to_h.bias"] = \
+                np.asarray(h["mlp_proj"]["bias"])
+            i += 1
+        return sd
+
+
+def convert_megatron_checkpoint(shards: Sequence[Dict[str, Any]],
+                                num_heads: int,
+                                max_seq_len: Optional[int] = None,
+                                version: int = 1, dtype: Any = None):
+    """Per-MP-rank Megatron state dicts (rank order; a single dict is
+    degree 1) → (GPT module, merged params). The reference needs its
+    megatron sd loader to target a new MP degree file-by-file
+    (state_dict_factory.py:199); here the merged tree serves ANY degree —
+    hand it to ``init_inference(..., mp_size=N)`` and GSPMD re-partitions.
+    """
+    if isinstance(shards, dict):
+        shards = [shards]
+    shards = [normalize_megatron_keys(s) for s in shards]
+    if version == 0:
+        # De-interleave per rank BEFORE merging: each rank's rows are
+        # per-head interleaved within its own head slice.
+        heads_per_rank = num_heads // len(shards)
+        fixed = []
+        for s in shards:
+            t = dict(s)
+            for k in t:
+                if k.endswith("query_key_value.weight") or \
+                        k.endswith("query_key_value.bias"):
+                    t[k] = _deinterleave_qkv_v0(t[k], heads_per_rank)
+            fixed.append(t)
+        shards = fixed
+    merged = _merge_dotted(shards)
+    return MegatronLayerPolicy.convert(merged, num_heads,
+                                       max_seq_len=max_seq_len, version=1,
+                                       dtype=dtype)
+
+
+def split_megatron_state_dict(sd: Dict[str, Any], mp: int
+                              ) -> List[Dict[str, np.ndarray]]:
+    """Split a full (version >= 1) Megatron state dict into ``mp`` per-rank
+    shards — the reference's ``split_state_dict`` direction
+    (state_dict_factory.py), used to emit Megatron-consumable checkpoints
+    and to build synthetic MP fixtures."""
+    from deepspeed_tpu.runtime.state_dict_factory import _split_qkv
+
+    sd = normalize_megatron_keys(sd)
+    if mp == 1:
+        return [dict(sd)]
+    rules = megatron_mp_rules()
+    out: List[Dict[str, np.ndarray]] = [{} for _ in range(mp)]
+    for key, leaf in sd.items():
+        action = None
+        for pat, a in rules:
+            if re.search(pat, key):
+                action = a
+                break
+        if action is None:
+            for r in range(mp):
+                out[r][key] = leaf
+            continue
+        kind, axis = action
+        if leaf.shape[axis] % ((3 * mp) if kind == "qkv" else mp):
+            raise ValueError(f"'{key}' dim {axis} ({leaf.shape[axis]}) not "
+                             f"divisible for mp={mp}")
+        pieces = (_split_qkv(leaf, mp, axis) if kind == "qkv"
+                  else np.split(leaf, mp, axis=axis))
+        for r in range(mp):
+            out[r][key] = pieces[r]
+    return out
+
+
+def _merge_dotted(shards: Sequence[Dict[str, np.ndarray]]
+                  ) -> Dict[str, np.ndarray]:
+    """merge_mp_checkpoints over flat dotted-key dicts."""
+    if len(shards) == 1:
+        return dict(shards[0])
+    rules = megatron_mp_rules()
+    out = {}
+    for key in shards[0]:
+        pieces = [np.asarray(s[key]) for s in shards]
+        action = None
+        for pat, a in rules:
+            if re.search(pat, key):
+                action = a
+                break
+        if action is None:
+            out[key] = pieces[0]
+        elif action[0] == "cat":
+            out[key] = np.concatenate(pieces, axis=action[1])
+        elif action[0] == "qkv":
+            out[key] = _merge_qkv(pieces, action[1])
+    return out
+
+
+__all__ = ["MegatronLayerPolicy", "convert_megatron_checkpoint",
+           "megatron_mp_rules", "normalize_megatron_keys",
+           "split_megatron_state_dict"]

@@ -1,0 +1,76 @@
+"""Fused counter-hash dropout for activations.
+
+Reference: ``csrc/transformer/dropout_kernels.cu`` — the reference's fused
+kernels apply dropout nearly free by folding a Philox draw into the same
+pass as the surrounding op. The stock flax path costs real time on TPU:
+``jax.random.bernoulli`` lowers threefry2x32 (a long scalar-op chain per
+element) plus an fp32 uniform and a select, paid once per dropout site per
+microbatch (3 sites/layer on GPT).
+
+This op replaces the draw with the SAME counter-based integer hash the
+flash kernel's in-kernel dropout uses (``ops/transformer/flash_attention.
+dropout_keep_mask``): one iota + ~5 integer ops + an int compare per
+element, all fused by XLA into the neighbouring elementwise chain — the
+mask never hits HBM. Statistical quality is the hash's (splitmix-style
+avalanche), deterministic given the rng key, decorrelated across sites by
+the flax rng path fold.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _hash_u32(x: jax.Array) -> jax.Array:
+    # splitmix32-style finalizer (same avalanche core as the flash
+    # kernel's _hash_u32; duplicated to keep this module pallas-free).
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_dropout(x: jax.Array, rate: float,
+                 rng: Optional[jax.Array]) -> jax.Array:
+    """Dropout via a counter hash: keep-prob ``1-rate``, scaled by
+    ``1/(1-rate)``. ``rng``: a PRNG key (only its bits are consumed)."""
+    if rate <= 0.0 or rng is None:
+        return x
+    kd = jax.random.key_data(rng).astype(jnp.uint32).reshape(-1)
+    seed = kd[0] ^ (kd[-1] << jnp.uint32(1))
+    idx = jax.lax.iota(jnp.uint32, x.size).reshape(x.shape)
+    bits = _hash_u32(idx * jnp.uint32(0x9E3779B9)
+                     ^ (seed + jnp.uint32(0x165667B1)))
+    # top 24 bits vs integer threshold (shared convention with the flash
+    # kernel's dropout_keep_mask: int compare, no uint->float cast)
+    thresh = int(float(rate) * (1 << 24))
+    keep = (bits >> jnp.uint32(8)).astype(jnp.int32) >= thresh
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def dropout_module(cfg):
+    """The model families' dropout selector: :class:`HashDropout` when
+    ``cfg.fast_dropout`` (default for the in-tree families — measured
+    +19.9% on dropout-on GPT-2, PROFILE.md r4), else ``nn.Dropout``."""
+    if getattr(cfg, "fast_dropout", False):
+        return HashDropout
+    return nn.Dropout
+
+
+class HashDropout(nn.Module):
+    """Drop-in for ``nn.Dropout(rate, deterministic=...)`` backed by
+    :func:`hash_dropout`; draws its key from the ``dropout`` rng
+    collection (the flax path fold decorrelates sites/layers)."""
+
+    rate: float
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        if self.deterministic or self.rate <= 0.0:
+            return x
+        return hash_dropout(x, self.rate, self.make_rng("dropout"))

@@ -1,0 +1,61 @@
+"""Embedding lookup with an MXU-matmul gradient.
+
+The forward is an ordinary row gather (cheap everywhere). The BACKWARD of a
+gather is a scatter-add into the [V, D] table, which XLA lowers on TPU to a
+slow serialized scatter (measured 0.6 GB + scatter per GPT-2 microbatch,
+PROFILE.md r3). ``matmul_grad=True`` swaps that transpose for a one-hot
+contraction ``dW = onehot(ids)ᵀ @ g`` — a [V, N] x [N, D] matmul that rides
+the MXU with fp32 accumulation; the one-hot lowers to an elementwise
+compare fused into the matmul operand.
+
+Reference analogue: none — torch's embedding backward is a CUDA
+scatter/atomics kernel (fast on GPU); this is a TPU-roofline redesign.
+Numerics: the matmul path sums contributions in fp32 in a fixed reduction
+order — parity-tested against the scatter path in tests/test_models.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.custom_vjp
+def _lookup_matmul_grad(table, ids):
+    return jnp.take(table, ids, axis=0)
+
+
+def _lookup_fwd(table, ids):
+    # The table residual is a reference (params stay live anyway), not a
+    # copy; it carries the static vocab size and dtype into the backward.
+    return jnp.take(table, ids, axis=0), (table, ids)
+
+
+def _lookup_bwd(res, g):
+    table, ids = res
+    v = table.shape[0]
+    d = g.shape[-1]
+    oh = jax.nn.one_hot(ids.reshape(-1), v, dtype=g.dtype)
+    dtable = jnp.einsum("nv,nd->vd", oh, g.reshape(-1, d),
+                        preferred_element_type=jnp.float32)
+    return dtable.astype(table.dtype), np.zeros(ids.shape, jax.dtypes.float0)
+
+
+_lookup_matmul_grad.defvjp(_lookup_fwd, _lookup_bwd)
+
+
+def embedding_lookup(table: jax.Array, ids: jax.Array,
+                     matmul_grad: bool = False) -> jax.Array:
+    """``table[ids]`` ([V, D] x [...] int -> [..., D]) with a selectable
+    gradient path: XLA scatter-add (default) or the one-hot MXU matmul."""
+    if matmul_grad:
+        return _lookup_matmul_grad(table, ids)
+    return jnp.take(table, ids, axis=0)
+
+
+def vocab_pad_mask(padded_vocab: int, vocab_size: int) -> jax.Array:
+    """[padded_vocab] fp32 additive logit mask: 0 on real rows, -1e9 on pad
+    rows — keeps a padded-vocab CE numerically identical to the unpadded
+    model (pad logits vanish from the logsumexp; pad table rows get zero
+    gradient and stay at init)."""
+    return jnp.where(jnp.arange(padded_vocab) < vocab_size,
+                     0.0, -1e9).astype(jnp.float32)

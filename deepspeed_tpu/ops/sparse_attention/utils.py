@@ -1,0 +1,147 @@
+"""Config-driven sparse-attention wiring — the analogue of the reference's
+``SparseAttentionUtils`` model surgery
+(``deepspeed/ops/sparse_attention/sparse_attention_utils.py:1-225``) and the
+``sparse_attention`` config presets
+(``deepspeed/runtime/config.py:261-407``).
+
+TPU-first surgery: the reference swaps ``nn.Module`` attention instances
+inside a pretrained torch model; here the in-tree model families route
+attention by CONFIG (``GPTConfig.sparse_attention`` /
+``BertConfig.sparse_attention``), so "replacing self-attention" is a frozen
+-dataclass ``replace`` — no weight surgery, since a sparse layout masks the
+same dense projections. ``deepspeed_tpu.initialize`` applies it
+automatically when the DeepSpeed config carries a ``sparse_attention``
+block.
+"""
+
+import dataclasses
+import functools
+import json
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.sparse_attention.sparse_attention import (
+    SparseSelfAttention, pad_to_block_size)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, SparsityConfig, VariableSparsityConfig)
+
+# Reference mode names (runtime/config.py:249-258 SPARSE_*_MODE).
+SPARSE_MODES = {
+    "dense": DenseSparsityConfig,
+    "fixed": FixedSparsityConfig,
+    "variable": VariableSparsityConfig,
+    "bigbird": BigBirdSparsityConfig,
+    "bslongformer": BSLongformerSparsityConfig,
+}
+
+
+def sparsity_config_from_dict(d: Dict[str, Any],
+                              num_heads: int) -> SparsityConfig:
+    """Build a SparsityConfig from a ``sparse_attention`` config block —
+    same keys as the reference's presets (``mode``, ``block``,
+    ``num_local_blocks``, ``num_sliding_window_blocks``, ...)."""
+    d = dict(d or {})
+    mode = d.pop("mode", "fixed")
+    d.pop("impl", None)   # executor choice, not a layout parameter
+    if mode not in SPARSE_MODES:
+        raise ValueError(f"unknown sparse_attention mode '{mode}' "
+                         f"(one of {sorted(SPARSE_MODES)})")
+    try:
+        return SPARSE_MODES[mode](num_heads=num_heads, **d)
+    except TypeError as e:
+        raise ValueError(
+            f"invalid sparse_attention key for mode '{mode}': {e}") from None
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_ssa(cfg_json: str, num_heads: int, impl: str):
+    d = json.loads(cfg_json)
+    return SparseSelfAttention(sparsity_config_from_dict(d, num_heads),
+                               impl=impl)
+
+
+def get_sparse_self_attention(d: Dict[str, Any], num_heads: int,
+                              impl: str = None) -> SparseSelfAttention:
+    """Cached layout-bound attention for a config block (model families
+    call this per block — the layout is built once per (config, seq))."""
+    if impl is None:
+        impl = (d or {}).get("impl", "auto")
+    return _cached_ssa(json.dumps(d or {}, sort_keys=True), num_heads, impl)
+
+
+class SparseAttentionUtils:
+    """Reference-named utility surface (sparse_attention_utils.py:14)."""
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            model, sparse_attention_config: Dict[str, Any]):
+        """Route an in-tree family's attention through the sparse executor.
+        Parameter-free: a sparse layout masks the same dense q/k/v
+        projections, so the params tree is unchanged (unlike the
+        reference's module transplant, :177)."""
+        cfg = getattr(model, "cfg", None)
+        if cfg is None or not hasattr(cfg, "sparse_attention"):
+            raise ValueError(
+                f"sparse attention surgery supports the in-tree model "
+                f"families (GPT/BERT with a `sparse_attention` config "
+                f"field); got {type(model).__name__} — route attention "
+                f"through ops.sparse_attention.SparseSelfAttention in your "
+                f"model instead")
+        new_cfg = dataclasses.replace(
+            cfg, sparse_attention=dict(sparse_attention_config))
+        return type(model)(new_cfg)
+
+    @staticmethod
+    def extend_position_embedding(params: Dict[str, Any], max_position: int,
+                                  key: str = "wpe") -> Dict[str, Any]:
+        """Tile a learned position table to a longer max length (reference
+        :19 repeats the pretrained table). Returns a NEW params tree."""
+        table = params[key]
+        orig = table.shape[0]
+        if max_position <= orig:
+            raise ValueError(f"max_position {max_position} must exceed the "
+                             f"current table length {orig}")
+        reps = -(-max_position // orig)
+        new = jnp.tile(table, (reps, 1))[:max_position]
+        out = dict(params)
+        out[key] = new
+        return out
+
+    @staticmethod
+    def pad_to_block_size(block_size: int, input_ids, pad_token_id: int = 0,
+                          attention_mask=None, labels=None
+                          ) -> Tuple[int, Dict[str, Any]]:
+        """Right-pad a token batch to a block multiple (reference :142):
+        ids with ``pad_token_id``, mask with 0, labels with -100. Returns
+        ``(pad_len, batch_dict)``."""
+        s = input_ids.shape[1]
+        pad = (-s) % block_size
+        batch = {"input_ids": input_ids}
+        if attention_mask is None:
+            attention_mask = jnp.ones(input_ids.shape, jnp.int32)
+        if pad:
+            batch["input_ids"] = jnp.pad(input_ids, ((0, 0), (0, pad)),
+                                         constant_values=pad_token_id)
+            attention_mask = jnp.pad(attention_mask, ((0, 0), (0, pad)))
+            if labels is not None:
+                labels = jnp.pad(labels, ((0, 0), (0, pad)),
+                                 constant_values=-100)
+        batch["attention_mask"] = attention_mask
+        if labels is not None:
+            batch["labels"] = labels
+        return pad, batch
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int, sequence_output):
+        """Reference :208 — strip the pad tail added by pad_to_block_size."""
+        if pad_len:
+            return sequence_output[:, :-pad_len]
+        return sequence_output
+
+
+__all__ = ["SPARSE_MODES", "SparseAttentionUtils",
+           "get_sparse_self_attention", "sparsity_config_from_dict",
+           "pad_to_block_size"]

@@ -217,6 +217,9 @@ def _flash_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
             jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_vmem_params(
+            (2 * sk * d + 2 * block_q * d) * q.dtype.itemsize
+            + block_q * LANES * 4 + (4 * sk if has_mask else 0)),
     )(seed, *inputs)
     return out, lse
 
@@ -347,6 +350,20 @@ def _bwd_dkv_kernel(seed_ref, *refs, causal: bool, scale: float, block_q: int,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _vmem_params(est_bytes: int):
+    """Raise Mosaic's scoped-VMEM cap (default 16 MiB) when a kernel
+    instance's double-buffered working set won't fit — the long-sequence
+    backward keeps whole-sequence q/do/lse/delta refs per instance, which
+    at seq 4096 overflows the default by ~1 MiB (v5e has 128 MiB VMEM).
+    ``est_bytes`` is the single-buffered per-instance sum; ×4 + 16 MiB
+    covers double buffering plus the compiler's own stack slack (measured:
+    Mosaic asked for ~2% above a bare ×4 at seq 16384)."""
+    if est_bytes * 4 <= 16 * 2**20:
+        return None
+    return pltpu.CompilerParams(
+        vmem_limit_bytes=int(min(100 * 2**20, est_bytes * 4 + 16 * 2**20)))
+
+
 def _flash_backward(res, g, causal, scale, block_q, block_k, interpret,
                     nheads=1, dropout_rate=0.0):
     q, k, v, kv_mask, out, lse, seed = res
@@ -382,6 +399,9 @@ def _flash_backward(res, g, causal, scale, block_q, block_k, interpret,
                                    lambda b, i, s: (b, i, 0))),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         interpret=interpret,
+        compiler_params=_vmem_params(
+            (2 * sk * d + 3 * block_q * d) * q.dtype.itemsize
+            + 2 * block_q * LANES * 4),
     )(seed, *dq_inputs)
 
     dkv_in_specs = [
@@ -414,6 +434,9 @@ def _flash_backward(res, g, causal, scale, block_q, block_k, interpret,
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         interpret=interpret,
+        compiler_params=_vmem_params(
+            (2 * sq * d + 4 * block_k * d) * q.dtype.itemsize
+            + 2 * sq * LANES * 4),
     )(seed, *dkv_inputs)
     return dq, dk, dv
 

@@ -117,11 +117,15 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
     }
 
     def embed_fn(params, batch, rng):
+        from deepspeed_tpu.ops.embedding import embedding_lookup
+
         ids = batch["input_ids"]
         s = ids.shape[1]
         emb = params["embed"]
-        x = (emb["wte"][ids].astype(cfg.dtype) +
-             emb["wpe"][:s][None].astype(cfg.dtype))
+        tok = embedding_lookup(
+            emb["wte"], ids,
+            matmul_grad=getattr(cfg, "embed_grad_matmul", False))
+        x = tok.astype(cfg.dtype) + emb["wpe"][:s][None].astype(cfg.dtype)
         if rng is not None and cfg.dropout_rate > 0.0:
             keep = jax.random.bernoulli(rng, 1.0 - cfg.dropout_rate, x.shape)
             x = jnp.where(keep, x / (1.0 - cfg.dropout_rate), 0.0)
@@ -152,8 +156,12 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
 
         h = ln_f.apply({"params": params["head"]["ln_f"]}, x)
         labels = shift_labels(batch)
+        mask = None
         if cfg.tie_embeddings:
             w, wt = params["embed"]["wte"], False
+            if getattr(cfg, "padded_vocab", cfg.vocab_size) != cfg.vocab_size:
+                from deepspeed_tpu.ops.embedding import vocab_pad_mask
+                mask = vocab_pad_mask(cfg.padded_vocab, cfg.vocab_size)
         else:
             w, wt = params["head"]["lm_head"]["kernel"], True
         if not getattr(cfg, "fused_ce", True):
@@ -162,10 +170,11 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
             logits = jnp.einsum("bsd,vd->bsv" if not wt else "bsd,dv->bsv",
                                 h.astype(cfg.dtype), w.astype(cfg.dtype),
                                 preferred_element_type=jnp.float32)
-            return cross_entropy_with_ignore(logits, labels)
+            return cross_entropy_with_ignore(logits[..., :cfg.vocab_size],
+                                             labels)
         return fused_cross_entropy(
             h.astype(cfg.dtype), w.astype(cfg.dtype), labels,
-            w_transposed=wt,
+            w_transposed=wt, bias=mask, bias_grad=mask is None,
             logits_fp32=getattr(cfg, "fused_ce_fp32_logits", False))
 
     return PipeModel(embed_fn=embed_fn, block_fn=block_fn,

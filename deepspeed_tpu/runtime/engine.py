@@ -415,13 +415,21 @@ class TPUEngine:
         if self._offload_param_cfg.enabled:
             # Param tier: compute-dtype params live in pinned host memory,
             # ZeRO-3-partitioned over `data`; the (streamed) loss_fn fetches
-            # blocks on-device inside the step. TP base specs are not
-            # composed here — the streamed fetch replicates each block.
+            # blocks on-device inside the step. When the streamed loss was
+            # built with TP specs (build_streamed_loss tp_specs=...), it
+            # publishes shard-aligned storage specs for the packed blocks —
+            # each host then stores its (data x model) shard and the fetch
+            # moves 1/(dp*tp) of every block (ZeRO-Infinity x MP, reference
+            # stage3.py:590 mpu composition).
             from deepspeed_tpu.runtime.zero import param_offload as po
             # Shard count is the ICI-inner data axis only — dp_size also
             # counts dcn slices, which store their own host partitions.
             specs = po.host_storage_specs(
                 params, self.mesh.shape.get(DATA_AXIS, 1))
+            overrides = getattr(self.loss_fn,
+                                "host_storage_spec_overrides", None)
+            if overrides:
+                specs = {**specs, **overrides}
             self._compute_shardings = po.host_shardings(mesh, specs)
             self._compute_params = jax.device_put(
                 po.cast_host(params, compute_dtype), self._compute_shardings)

@@ -129,6 +129,140 @@ def pack_blocks(blocks: Any):
     return flat, (treedef, shapes, sizes, tuple(dtypes))
 
 
+def pack_blocks_tp(blocks: Any, leaf_specs: Any, mesh, data_size: int):
+    """Tensor-parallel-aware flat packing (ZeRO-Infinity × MP composition,
+    reference ``stage3.py:590`` takes an mpu for the same reason).
+
+    Leaves with a model-axis PartitionSpec (one-block specs, no leading L)
+    are packed PER TP SHARD: ``tp_buf [L, tp, R, 128]`` whose dim 1 is
+    sharded over the model axes and dim 2 over ``data`` — each device's
+    host partition holds exactly its TP shard of every block, so the
+    streamed fetch moves 1/(dp·tp) of the block and the rebuilt leaves are
+    born TP-sharded (no gather past the shard level). Unsharded leaves
+    (biases, norms) keep the replicated-row layout of :func:`pack_blocks`.
+
+    Returns ``({"tp": buf|None, "rep": buf|None}, meta)``; falls back to
+    the plain layout (``tp is None``) when no leaf is model-sharded.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(blocks)
+    specs = treedef.flatten_up_to(leaf_specs)
+    mesh_shape = dict(mesh.shape)
+    num = leaves[0].shape[0]
+
+    tp_axes = None
+    recs = []   # (is_tp, shard_dim j, shape, dtype)
+    for leaf, spec in zip(leaves, specs):
+        dims = tuple(leaf.shape[1:])
+        entries = tuple(spec) if spec is not None else ()
+        entries = entries + (None,) * (len(dims) - len(entries))
+        j = None
+        axes = None
+        for i, e in enumerate(entries):
+            parts = e if isinstance(e, tuple) else ((e,) if e else ())
+            parts = tuple(a for a in parts
+                          if a != DATA_AXIS and mesh_shape.get(a, 1) > 1)
+            if parts:
+                if j is not None:
+                    raise ValueError(
+                        "pack_blocks_tp: at most one model-sharded dim per "
+                        f"leaf (got spec {spec})")
+                j, axes = i, parts
+        if j is not None:
+            if tp_axes is None:
+                tp_axes = axes
+            elif tp_axes != axes:
+                raise ValueError(
+                    f"pack_blocks_tp: all model-sharded leaves must use the "
+                    f"same axes (got {axes} vs {tp_axes})")
+        recs.append((j, dims, leaf.dtype))
+
+    tp = 1
+    if tp_axes is not None:
+        for a in tp_axes:
+            tp *= mesh_shape[a]
+    if tp <= 1:
+        flat, meta = pack_blocks(blocks)
+        return {"tp": None, "rep": flat}, {
+            "treedef": treedef, "recs": recs, "tp_axes": None, "tp": 1,
+            "rep_meta": meta, "specs": specs}
+
+    tp_parts, rep_leaves = [], []
+    for leaf, (j, dims, _) in zip(leaves, recs):
+        if j is None:
+            rep_leaves.append(leaf)
+            continue
+        if dims[j] % tp:
+            raise ValueError(f"dim {dims[j]} not divisible by tp={tp}")
+        arr = jnp.moveaxis(leaf, j + 1, 1)           # [L, dj, rest...]
+        tp_parts.append(arr.reshape(num, tp, -1))    # [L, tp, dj/tp*rest]
+    tp_flat = jnp.concatenate(tp_parts, axis=2)
+    align = 128 * 8 * max(data_size, 1)
+    pad = (-tp_flat.shape[2]) % align
+    if pad:
+        tp_flat = jnp.pad(tp_flat, ((0, 0), (0, 0), (0, pad)))
+    tp_flat = tp_flat.reshape(num, tp, -1, 128)
+
+    rep_flat, rep_meta = (None, None)
+    if rep_leaves:
+        rep_flat, rep_meta = pack_blocks(
+            jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(list(range(len(rep_leaves)))),
+                rep_leaves))
+    meta = {"treedef": treedef, "recs": recs, "tp_axes": tp_axes, "tp": tp,
+            "rep_meta": rep_meta, "specs": specs}
+    return {"tp": tp_flat, "rep": rep_flat}, meta
+
+
+def unpack_block_tp(rows, meta, mesh) -> Any:
+    """One block from the TP-aware packed layout. ``rows``: dict with
+    ``tp`` [tp, R, 128] (dim 0 model-sharded) and ``rep`` [R2, 128].
+    Rebuilt TP leaves are constrained to their one-block specs, so the
+    merge reshape stays device-local (dim 0 and the target shard dim carry
+    the same axes)."""
+    treedef, recs = meta["treedef"], meta["recs"]
+    tp, tp_axes = meta["tp"], meta["tp_axes"]
+    specs = meta["specs"]
+    if tp_axes is None:
+        return unpack_block(rows["rep"], meta["rep_meta"])
+
+    def shard_leaves(chunk):
+        flat = chunk.reshape(-1)
+        out, off = [], 0
+        for j, dims, dt in recs:
+            if j is None:
+                continue
+            n = int(np.prod(dims)) // tp
+            moved = (dims[j] // tp,) + tuple(
+                d for i, d in enumerate(dims) if i != j)
+            out.append(flat[off:off + n].reshape(moved))
+            off += n
+        return out
+
+    shards = jax.vmap(shard_leaves)(rows["tp"])  # leaves [tp, dj/tp, rest]
+    rep_leaves = []
+    if rows.get("rep") is not None:
+        rep_tree = unpack_block(rows["rep"], meta["rep_meta"])
+        rep_leaves = jax.tree_util.tree_leaves(rep_tree)
+    rep_i = 0
+    tp_i = 0
+    leaves = []
+    for (j, dims, dt), spec in zip(recs, specs):
+        if j is None:
+            leaves.append(rep_leaves[rep_i])
+            rep_i += 1
+            continue
+        x = shards[tp_i]
+        tp_i += 1
+        # [tp, dj/tp, rest...] -> [dj, rest...] -> moveaxis back to j
+        x = x.reshape((dims[j],) + x.shape[2:])
+        x = jnp.moveaxis(x, 0, j)
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec if spec is not None
+                             else PartitionSpec()))
+        leaves.append(x)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def unpack_block(row: jax.Array, meta) -> Any:
     """One packed [P/128, 128] row -> the single-block param tree (static
     slices — fused by XLA, no copies).
@@ -148,7 +282,8 @@ def unpack_block(row: jax.Array, meta) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def build_streamed_loss(pipe_model, remat: bool = True, params: Any = None):
+def build_streamed_loss(pipe_model, remat: bool = True, params: Any = None,
+                        tp_specs: Any = None, mesh=None):
     """(loss_fn, host_layout_params) over HOST-resident params.
 
     ``loss_fn(host_params, batch, rng) -> loss`` with per-block device
@@ -159,25 +294,48 @@ def build_streamed_loss(pipe_model, remat: bool = True, params: Any = None):
     forward copy live. The returned params tree stores the blocks
     flat-packed (:func:`pack_blocks`).
 
+    ``tp_specs`` + ``mesh``: one-block PartitionSpecs for tensor-parallel
+    composition — the packing becomes shard-aligned
+    (:func:`pack_blocks_tp`) so each device stores and fetches only its TP
+    shard; ``loss_fn.host_storage_spec_overrides`` then carries the
+    storage specs the engine must use for the blocks entry.
+
     ``params``: optional weights to serve instead of the PipeModel's —
     either pipe layout (blocks get packed) or an already-packed tree
     (e.g. restored from an offload checkpoint; used as-is after a shape
     check — re-packing a packed array would destroy the block structure).
     """
     pm = pipe_model
-    flat, meta = pack_blocks(pm.params["blocks"])
+    data_size = mesh.shape.get(DATA_AXIS, 1) if mesh is not None else 1
+    use_tp = tp_specs is not None and mesh is not None
+    if use_tp:
+        packed, meta = pack_blocks_tp(pm.params["blocks"], tp_specs, mesh,
+                                      data_size)
+        use_tp = meta["tp_axes"] is not None
+    if not use_tp:
+        flat, meta = pack_blocks(pm.params["blocks"])
+        packed = flat
+
+    def shapes_of(tree):
+        return jax.tree_util.tree_map(lambda x: tuple(x.shape), tree)
+
     if params is None:
-        blocks = flat
-        params = {"embed": pm.params["embed"], "blocks": flat,
+        blocks = packed
+        params = {"embed": pm.params["embed"], "blocks": packed,
                   "head": pm.params["head"]}
     else:
         blocks = params["blocks"]
-        if isinstance(blocks, dict):          # pipe layout: pack it
-            blocks, _ = pack_blocks(blocks)
-        if tuple(blocks.shape) != tuple(flat.shape):
+        looks_packed = (isinstance(blocks, jax.Array)
+                        or isinstance(blocks, np.ndarray)
+                        or (isinstance(blocks, dict)
+                            and set(blocks) == {"tp", "rep"}))
+        if not looks_packed:                   # pipe layout: pack it
+            blocks = (pack_blocks_tp(blocks, tp_specs, mesh, data_size)[0]
+                      if use_tp else pack_blocks(blocks)[0])
+        if shapes_of(blocks) != shapes_of(packed):
             raise ValueError(
-                f"provided blocks {tuple(blocks.shape)} do not match the "
-                f"model's packed layout {tuple(flat.shape)}")
+                f"provided blocks {shapes_of(blocks)} do not match the "
+                f"model's packed layout {shapes_of(packed)}")
         params = {"embed": params["embed"], "blocks": blocks,
                   "head": params["head"]}
 
@@ -192,7 +350,12 @@ def build_streamed_loss(pipe_model, remat: bool = True, params: Any = None):
         aux = pm.aux_fn(persistent, batch) if pm.aux_fn is not None else None
 
         def inner(row_host, x, sub):
-            blk = unpack_block(jax.device_put(row_host, _TO_DEVICE), meta)
+            fetched = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, _TO_DEVICE), row_host)
+            if use_tp:
+                blk = unpack_block_tp(fetched, meta, mesh)
+            else:
+                blk = unpack_block(fetched, meta)
             return pm.block_fn(blk, x, aux, sub)
 
         if remat:
@@ -209,4 +372,19 @@ def build_streamed_loss(pipe_model, remat: bool = True, params: Any = None):
         (x, rng), _ = jax.lax.scan(body, (x, rng), host_params["blocks"])
         return pm.head_fn(persistent, x, batch)
 
+    if use_tp:
+        tp_entry = (meta["tp_axes"][0] if len(meta["tp_axes"]) == 1
+                    else tuple(meta["tp_axes"]))
+        r_blocks = packed["tp"].shape[2]
+        over = {"tp": PartitionSpec(
+            None, tp_entry,
+            DATA_AXIS if data_size > 1 and r_blocks % data_size == 0
+            else None, None)}
+        if packed["rep"] is not None:
+            rr = packed["rep"].shape[1]
+            over["rep"] = PartitionSpec(
+                None,
+                DATA_AXIS if data_size > 1 and rr % data_size == 0 else None,
+                None)
+        loss_fn.host_storage_spec_overrides = {"blocks": over}
     return loss_fn, params

@@ -102,6 +102,12 @@ class ZeroPartitioner:
         """Add a data-axis sharding to base_spec on the best free dimension."""
         base = tuple(base_spec) if base_spec is not None else ()
         base = base + (None,) * (len(shape) - len(base))
+        # A base spec may already place the data axis (e.g. TiledLinear's
+        # stage-3 kernel spec) — adding it again would duplicate the axis.
+        for s in base:
+            parts = s if isinstance(s, tuple) else (s,)
+            if DATA_AXIS in parts:
+                return PartitionSpec(*base)
         # Dimensions already taken by model/sequence axes are not available.
         free_dims = [i for i, s in enumerate(base) if s is None]
         candidates = []

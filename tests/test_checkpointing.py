@@ -112,3 +112,61 @@ def test_consolidate_to_fp32(tmp_path, rng):
     got = flat["head.w"]
     np.testing.assert_allclose(got, np.asarray(ref["head"]["w"]), rtol=0, atol=0)
     assert all(v.dtype == np.float32 for v in flat.values())
+
+
+class TestPipelineRepartition:
+    """Checkpoint trained at one pipeline depth reloads at another
+    (round-3 VERDICT task 5; reference saves per-layer files for this,
+    pipe/module.py:517-585 — here the stacked-blocks tree IS per-layer
+    addressable on its leading dim, so pp-resize is an orbax reshard)."""
+
+    def _engine(self, stages, eight_devices=None):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+        from deepspeed_tpu.models.gpt import GPTConfig
+        from deepspeed_tpu.parallel.mesh import build_mesh
+        from deepspeed_tpu.parallel.pipe import (PipelineEngine,
+                                                 gpt_pipe_model)
+
+        cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=32,
+                        num_layers=4, num_heads=2, dropout_rate=0.0,
+                        dtype=jnp.float32)
+        pm = gpt_pipe_model(cfg)
+        mesh = build_mesh(data=8 // stages, pipe=stages)
+        ds = DeepSpeedTPUConfig({
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+        })
+        return PipelineEngine(pm, ds, mesh=mesh), cfg
+
+    @pytest.mark.parametrize("pp_to", [1, 4])
+    def test_pp2_reloads_at_other_depths(self, eight_devices, tmp_path,
+                                         pp_to):
+        import numpy as np
+
+        e2, cfg = self._engine(2)
+        rng = np.random.default_rng(0)
+        batches = {"input_ids": rng.integers(0, cfg.vocab_size, (4, 8, 16),
+                                             dtype=np.int32)}
+        for _ in range(3):
+            e2.train_batch(batches)
+        e2.save_checkpoint(str(tmp_path), client_state={"pp": 2})
+        ref_eval = float(e2.eval_batch(batches))
+
+        e_new, _ = self._engine(pp_to)
+        _, client = e_new.load_checkpoint(str(tmp_path))
+        assert client["pp"] == 2
+        assert e_new.global_steps == e2.global_steps
+        # params bit-equal through the reshard
+        for a, b in zip(jax.tree_util.tree_leaves(e2.state.params),
+                        jax.tree_util.tree_leaves(e_new.state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(float(e_new.eval_batch(batches)),
+                                   ref_eval, rtol=1e-6)
+        # training continues identically at the new depth (one step)
+        l2 = float(e2.train_batch(batches))
+        ln = float(e_new.train_batch(batches))
+        np.testing.assert_allclose(ln, l2, rtol=2e-4, atol=2e-4)

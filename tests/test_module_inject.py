@@ -125,3 +125,86 @@ class TestGPTNeoInjection:
         assert eng.module.cfg.max_seq_len == 32
         out = eng.generate(jnp.zeros((1, 8), jnp.int32), max_new_tokens=4)
         assert out.shape == (1, 12)
+
+
+class TestMegatronPolicy:
+    """Megatron injection + MP-checkpoint import (round-3 VERDICT task 7;
+    reference MegatronLayerPolicy replace_policy.py:146 + megatron sd
+    loader state_dict_factory.py:199 + revert replace_module.py:310)."""
+
+    def _gpt_and_params(self, seed=0):
+        from deepspeed_tpu.models.gpt import make_gpt
+
+        model, cfg = make_gpt("tiny", vocab_size=256, max_seq_len=32,
+                              hidden_size=32, num_layers=2, num_heads=4,
+                              dropout_rate=0.0, dtype=jnp.float32)
+        batch = {"input_ids": np.zeros((2, 16), np.int32)}
+        params = model.init({"params": jax.random.PRNGKey(seed),
+                             "dropout": jax.random.PRNGKey(1)},
+                            batch)["params"]
+        return model, cfg, params
+
+    def test_revert_convert_roundtrip_bit_equal(self):
+        from deepspeed_tpu.module_inject.megatron import MegatronLayerPolicy
+
+        model, cfg, params = self._gpt_and_params()
+        sd = MegatronLayerPolicy.revert(params, cfg.num_heads)
+        model2, params2 = MegatronLayerPolicy.convert(
+            sd, cfg.num_heads, max_seq_len=cfg.max_seq_len,
+            layer_norm_epsilon=cfg.layer_norm_epsilon)
+        assert model2.cfg.num_layers == cfg.num_layers
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            params, params2)
+
+    def test_version0_interleaving_roundtrip(self):
+        from deepspeed_tpu.module_inject.megatron import (
+            MegatronLayerPolicy, convert_megatron_checkpoint)
+
+        model, cfg, params = self._gpt_and_params(1)
+        sd_v0 = MegatronLayerPolicy.revert(params, cfg.num_heads, version=0)
+        # v0 rows are per-head interleaved -> differs from the v1 layout
+        sd_v1 = MegatronLayerPolicy.revert(params, cfg.num_heads, version=1)
+        k0 = "layers.0.attention.query_key_value.weight"
+        k1 = "layers.0.self_attention.query_key_value.weight"
+        assert not np.array_equal(sd_v0[k0], sd_v1[k1])
+        _, params2 = convert_megatron_checkpoint(
+            sd_v0, cfg.num_heads, max_seq_len=cfg.max_seq_len, version=0)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            params, params2)
+
+    def test_two_way_shards_merge_and_serve_at_mp1_and_mp4(
+            self, eight_devices):
+        """Synthetic 2-way Megatron checkpoint -> merged params -> logits
+        at mp=1 and mp=4 match (the VERDICT's done criterion)."""
+        import deepspeed_tpu
+        from deepspeed_tpu.module_inject.megatron import (
+            MegatronLayerPolicy, convert_megatron_checkpoint,
+            split_megatron_state_dict)
+
+        model, cfg, params = self._gpt_and_params(2)
+        full_sd = MegatronLayerPolicy.revert(params, cfg.num_heads)
+        shards = split_megatron_state_dict(full_sd, 2)
+        assert shards[0]["layers.0.self_attention.query_key_value.weight"]\
+            .shape[0] == 3 * cfg.hidden_size // 2
+        model2, merged = convert_megatron_checkpoint(
+            shards, cfg.num_heads, max_seq_len=cfg.max_seq_len,
+            dtype=jnp.float32)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            params, merged)
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (2, 16), dtype=np.int32)
+        outs = {}
+        for mp in (1, 4):
+            eng = deepspeed_tpu.init_inference(
+                model2, params=merged, mp_size=mp, dtype=jnp.float32)
+            out = eng.module.apply({"params": eng.params},
+                                   {"input_ids": ids}, deterministic=True)
+            outs[mp] = np.asarray(out["logits"], np.float32)
+        np.testing.assert_allclose(outs[1], outs[4], atol=2e-4, rtol=2e-4)

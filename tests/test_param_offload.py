@@ -190,3 +190,80 @@ class TestParamOffloadMemory:
         assert list(master_leaf.devices()) == [cpu]
         opt_leaf = jax.tree_util.tree_leaves(engine.offloader.opt_state)[0]
         assert list(opt_leaf.devices()) == [cpu]
+
+
+class TestTPComposition:
+    """offload_param x tensor parallelism (round-3 VERDICT task 6,
+    reference ZeRO-Infinity composes with MP via stage3.py:590's mpu):
+    shard-aligned packing stores each device's TP shard host-side, the
+    streamed fetch moves 1/(dp*tp) of each block, and numerics match the
+    replicated-fetch (tp=1) run."""
+
+    def _build(self, tp, rng, gas=2, bs=4):
+        from deepspeed_tpu.parallel.mesh import build_mesh
+
+        model, cfg = make_gpt("tiny", **GPT_CFG)
+        dp = 8 // tp
+        mesh = build_mesh(data=dp, model=tp)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, mesh=mesh,
+            config={
+                "train_micro_batch_size_per_gpu": bs * 2 // dp if dp else bs,
+                "gradient_accumulation_steps": gas,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": 3,
+                    "offload_param": {"device": "cpu"},
+                    "offload_optimizer": {"device": "cpu"}},
+            })
+        return engine, cfg
+
+    def test_host_shard_bytes_divide_by_tp(self, eight_devices):
+        rng = np.random.default_rng(0)
+        e_tp, cfg = self._build(2, rng)
+        blocks = e_tp._compute_params["blocks"]
+        assert isinstance(blocks, dict) and blocks["tp"] is not None
+        arr = blocks["tp"]
+        assert arr.sharding.memory_kind == po.HOST_MEMORY_KIND
+        # per-device shard = 1/(dp*tp) of the packed buffer
+        shard = arr.sharding.shard_shape(arr.shape)
+        total = int(np.prod(arr.shape))
+        per_dev = int(np.prod(shard))
+        assert per_dev * 8 == total, (shard, arr.shape)
+        # the model axis actually shards dim 1 (the tp dim)
+        assert shard[1] == arr.shape[1] // 2
+
+    def test_matches_tp1_numerics(self, eight_devices):
+        rng = np.random.default_rng(1)
+        e_tp, cfg = self._build(2, rng)
+        e_1, _ = self._build(1, rng)
+        batches = gpt_batch(rng, 2, 1, 32, cfg.vocab_size)
+        l_tp = [float(e_tp.train_batch(batches)) for _ in range(4)]
+        l_1 = [float(e_1.train_batch(batches)) for _ in range(4)]
+        np.testing.assert_allclose(l_tp, l_1, rtol=2e-4, atol=2e-4)
+
+    def test_pack_unpack_tp_roundtrip(self, eight_devices):
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.parallel.mesh import build_mesh
+
+        mesh = build_mesh(data=4, model=2)
+        rng = np.random.default_rng(2)
+        blocks = {
+            "w_col": jnp.asarray(rng.standard_normal((3, 8, 16)),
+                                 jnp.float32),   # sharded on dim 1
+            "w_row": jnp.asarray(rng.standard_normal((3, 16, 8)),
+                                 jnp.float32),   # sharded on dim 0
+            "bias": jnp.asarray(rng.standard_normal((3, 8)), jnp.float32),
+        }
+        specs = {"w_col": P(None, "model"), "w_row": P("model", None),
+                 "bias": P()}
+        packed, meta = po.pack_blocks_tp(blocks, specs, mesh, data_size=4)
+        assert packed["tp"].shape[1] == 2
+        for i in range(3):
+            row = jax.tree_util.tree_map(lambda a: a[i], packed)
+            blk = jax.jit(lambda r: po.unpack_block_tp(r, meta, mesh))(row)
+            for kname in blocks:
+                np.testing.assert_array_equal(
+                    np.asarray(blk[kname]), np.asarray(blocks[kname][i]),
+                    err_msg=kname)

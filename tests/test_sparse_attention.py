@@ -212,3 +212,196 @@ class TestSparseBackward:
             losses.append(float(loss(w)))
         assert losses[-1] < losses[0]
         assert np.isfinite(losses).all()
+
+
+class TestConfigDrivenSparse:
+    """sparse_attention config block -> model families -> training
+    (round-3 VERDICT task 3: previously the block parsed but nothing
+    consumed it; reference chain = runtime/config.py presets ->
+    SparseAttentionUtils surgery -> BertSparseSelfAttention)."""
+
+    SPARSE = {"mode": "bigbird", "block": 16, "num_random_blocks": 1,
+              "num_sliding_window_blocks": 3, "num_global_blocks": 1,
+              "attention": "unidirectional"}
+
+    def test_initialize_injects_sparse_into_gpt(self, eight_devices):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import make_gpt
+
+        model, cfg = make_gpt("tiny", dropout_rate=0.0, dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        batches = {"input_ids": rng.integers(0, cfg.vocab_size, (2, 8, 64),
+                                             dtype=np.int32)}
+        params = model.init(
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(1)},
+            {"input_ids": batches["input_ids"][0]})["params"]
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, params=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "gradient_accumulation_steps": 2,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 0},
+                    "sparse_attention": dict(self.SPARSE)})
+        losses = [float(engine.train_batch(batches)) for _ in range(8)]
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0] - 0.3, losses
+
+    def test_dense_mode_matches_dense_attention(self):
+        """mode='dense' through the model must equal the stock xla path —
+        the numerics oracle for the whole config chain."""
+        from deepspeed_tpu.models import make_gpt
+        from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+
+        m_d, cfg = make_gpt("tiny", dropout_rate=0.0, dtype=jnp.float32,
+                            attention_impl="xla")
+        rng = np.random.default_rng(1)
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size, (4, 64),
+                                           dtype=np.int32)}
+        p = m_d.init({"params": jax.random.PRNGKey(0),
+                      "dropout": jax.random.PRNGKey(1)}, batch)["params"]
+        m_s = (SparseAttentionUtils.
+               replace_model_self_attention_with_sparse_self_attention(
+                   m_d, {"mode": "dense", "block": 16, "impl": "xla"}))
+        ld = m_d.apply({"params": p}, batch, deterministic=True)["loss"]
+        ls = m_s.apply({"params": p}, batch, deterministic=True)["loss"]
+        np.testing.assert_allclose(float(ld), float(ls), rtol=2e-5)
+
+    def test_bert_sparse_with_padding_mask(self):
+        """BERT + bslongformer + key-padding mask: masked keys must not
+        influence unmasked positions (reference key_padding_mask)."""
+        from deepspeed_tpu.models import make_bert
+        from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+
+        m, cfg = make_bert("tiny", dropout_rate=0.0, dtype=jnp.float32)
+        m = (SparseAttentionUtils.
+             replace_model_self_attention_with_sparse_self_attention(
+                 m, {"mode": "bslongformer", "block": 16,
+                     "num_sliding_window_blocks": 3, "impl": "xla"}))
+        rng = np.random.default_rng(2)
+        ids = rng.integers(0, cfg.vocab_size, (2, 64), dtype=np.int32)
+        mask = np.ones((2, 64), np.int32)
+        mask[:, 48:] = 0
+        labels = np.where(rng.random((2, 64)) < 0.15, ids,
+                          -100).astype(np.int32)
+        labels[:, 48:] = -100   # padded tail predicts nothing
+        batch = {"input_ids": ids, "attention_mask": mask, "labels": labels}
+        p = m.init({"params": jax.random.PRNGKey(0),
+                    "dropout": jax.random.PRNGKey(1)}, batch)["params"]
+        out1 = m.apply({"params": p}, batch, deterministic=True)
+        # changing tokens in the masked tail must not change the loss
+        ids2 = ids.copy()
+        ids2[:, 48:] = (ids2[:, 48:] + 7) % cfg.vocab_size
+        batch2 = dict(batch, input_ids=ids2)
+        out2 = m.apply({"params": p}, batch2, deterministic=True)
+        np.testing.assert_allclose(float(out1["loss"]), float(out2["loss"]),
+                                   rtol=1e-6)
+
+    def test_surgery_rejects_opaque_model(self):
+        import flax.linen as nn
+
+        from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+
+        class Opaque(nn.Module):
+            @nn.compact
+            def __call__(self, batch):
+                return jnp.mean(batch["x"])
+
+        with pytest.raises(ValueError, match="in-tree"):
+            (SparseAttentionUtils.
+             replace_model_self_attention_with_sparse_self_attention(
+                 Opaque(), {"mode": "dense"}))
+
+    def test_config_presets_and_unknown_keys(self):
+        from deepspeed_tpu.ops.sparse_attention import \
+            sparsity_config_from_dict
+
+        for mode in ("dense", "fixed", "variable", "bigbird",
+                     "bslongformer"):
+            sc = sparsity_config_from_dict({"mode": mode, "block": 16}, 4)
+            assert sc.make_layout(64).shape == (4, 4, 4)
+        with pytest.raises(ValueError, match="unknown sparse_attention"):
+            sparsity_config_from_dict({"mode": "nope"}, 4)
+        with pytest.raises(ValueError, match="invalid sparse_attention"):
+            sparsity_config_from_dict({"mode": "fixed", "bogus": 1}, 4)
+
+    def test_pad_and_unpad_utils(self):
+        from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+
+        ids = np.arange(2 * 50, dtype=np.int32).reshape(2, 50) % 7
+        pad, batch = SparseAttentionUtils.pad_to_block_size(
+            16, jnp.asarray(ids), pad_token_id=3)
+        assert pad == 14 and batch["input_ids"].shape == (2, 64)
+        assert int(batch["attention_mask"][0, 49]) == 1
+        assert int(batch["attention_mask"][0, 50]) == 0
+        out = SparseAttentionUtils.unpad_sequence_output(
+            pad, jnp.zeros((2, 64, 8)))
+        assert out.shape == (2, 50, 8)
+
+    def test_extend_position_embedding(self):
+        from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+
+        params = {"wpe": jnp.asarray(np.random.default_rng(0)
+                                     .standard_normal((64, 8)), jnp.float32)}
+        new = SparseAttentionUtils.extend_position_embedding(params, 200)
+        assert new["wpe"].shape == (200, 8)
+        np.testing.assert_array_equal(np.asarray(new["wpe"][64:128]),
+                                      np.asarray(new["wpe"][:64]))
+
+
+class TestPallasKeyMask:
+    """Key-padding mask inside the Pallas sparse kernels (r4 review
+    finding: auto used to silently fall back to the dense-materializing
+    XLA executor whenever a mask was present — fatal at long seq)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_masked_pallas_matches_xla(self, causal):
+        from deepspeed_tpu.ops.sparse_attention import (
+            BigBirdSparsityConfig, sparse_attention)
+
+        rng = np.random.default_rng(0)
+        b, s, h, d, blk = 2, 128, 4, 64, 16
+        sc = BigBirdSparsityConfig(num_heads=h, block=blk,
+                                   num_random_blocks=1,
+                                   num_sliding_window_blocks=3,
+                                   num_global_blocks=1)
+        layout = sc.make_layout(s)
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32) * .1
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32) * .1
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32) * .1
+        mask = np.ones((b, s), np.int32)
+        mask[:, 100:] = 0
+        mask = jnp.asarray(mask)
+        ref = sparse_attention(q, k, v, layout, blk, causal=causal,
+                               key_mask=mask, impl="xla")
+        out = sparse_attention(q, k, v, layout, blk, causal=causal,
+                               key_mask=mask, impl="pallas")
+        np.testing.assert_allclose(np.asarray(out)[:, :100],
+                                   np.asarray(ref)[:, :100],
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_masked_grads_match_xla(self):
+        from deepspeed_tpu.ops.sparse_attention import (
+            BSLongformerSparsityConfig, sparse_attention)
+
+        rng = np.random.default_rng(1)
+        b, s, h, d, blk = 1, 64, 2, 64, 16
+        sc = BSLongformerSparsityConfig(num_heads=h, block=blk,
+                                        num_sliding_window_blocks=3)
+        layout = sc.make_layout(s)
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32) * .1
+        mask = np.ones((b, s), np.int32)
+        mask[:, 48:] = 0
+        mask = jnp.asarray(mask)
+        w = jnp.asarray(np.asarray(mask), jnp.float32)[:, :, None, None]
+
+        def loss(impl):
+            return lambda q, k, v: jnp.sum((sparse_attention(
+                q, k, v, layout, blk, key_mask=mask, impl=impl) * w) ** 2)
+
+        g_ref = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, q, q)
+        g_pal = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, q, q)
+        for a, r, name in zip(g_pal, g_ref, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       atol=3e-5, rtol=3e-5,
+                                       err_msg=f"d{name}")

@@ -1,0 +1,69 @@
+"""Round-4 GPT-2 lever A/B on the real chip: vocab padding (50257->50304)
+and the one-hot-matmul embedding gradient, alone and combined, against the
+round-3 bench config — all variants in ONE process (the tunnel's ±10-12%
+run-to-run drift makes cross-run comparison meaningless)."""
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def run_variant(name, steps=8, windows=2, **overrides):
+    import deepspeed_tpu
+    from deepspeed_tpu.models import make_gpt
+
+    model, cfg = make_gpt("gpt2", dropout_rate=0.0, remat=False,
+                          max_seq_len=512, **overrides)
+    rng = np.random.default_rng(0)
+    micro_bs, seq, gas = 16, 512, 8
+    batches = {"input_ids": rng.integers(0, cfg.vocab_size,
+                                         (gas, micro_bs, seq),
+                                         dtype=np.int32)}
+    one = jax.tree_util.tree_map(lambda x: x[0], batches)
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)}, one)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, params=params,
+        config={
+            "train_micro_batch_size_per_gpu": micro_bs,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 2},
+            "data_types": {"grad_accum_dtype": "bfloat16"},
+            "bf16": {"enabled": True},
+        })
+    for _ in range(2):
+        loss = engine.train_batch(batches)
+    _ = float(loss)
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batches)
+        _ = float(loss)   # scalar fetch = tunnel fence
+        best = min(best, time.perf_counter() - t0)
+    tokens = gas * micro_bs * seq * steps
+    tps = tokens / best
+    print(f"[{name}] {tps:,.0f} tok/s  (loss {float(loss):.3f})",
+          flush=True)
+    del engine
+    return tps
+
+
+def main():
+    print("platform:", jax.devices()[0].platform, flush=True)
+    base = run_variant("base          ")
+    pad = run_variant("vocab_pad     ", vocab_pad_multiple=128)
+    emb = run_variant("embed_matmul  ", embed_grad_matmul=True)
+    both = run_variant("both          ", vocab_pad_multiple=128,
+                       embed_grad_matmul=True)
+    print(f"pad: {pad/base - 1:+.1%}  emb: {emb/base - 1:+.1%}  "
+          f"both: {both/base - 1:+.1%} vs base", flush=True)
+
+
+if __name__ == "__main__":
+    main()
