@@ -310,6 +310,11 @@ class DeepSpeedTPUConfig:
         self.memory_breakdown = bool(_get(d, C.MEMORY_BREAKDOWN,
                                           C.MEMORY_BREAKDOWN_DEFAULT))
         self.dump_state = bool(_get(d, C.DUMP_STATE, C.DUMP_STATE_DEFAULT))
+        # Numerics debug mode (SURVEY §5's determinism/debug lever): every
+        # train_batch verifies loss and params are finite (one host sync
+        # per step — a DEBUG tool) and raises naming the step + leaves.
+        self.check_numerics = bool(_get(d, C.CHECK_NUMERICS,
+                                        C.CHECK_NUMERICS_DEFAULT))
         self.sparse_gradients_enabled = bool(_get(d, C.SPARSE_GRADIENTS,
                                                   C.SPARSE_GRADIENTS_DEFAULT))
 

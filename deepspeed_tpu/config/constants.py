@@ -85,6 +85,8 @@ WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
 WALL_CLOCK_BREAKDOWN_DEFAULT = False
 DUMP_STATE = "dump_state"
 DUMP_STATE_DEFAULT = False
+CHECK_NUMERICS = "check_numerics"
+CHECK_NUMERICS_DEFAULT = False
 MEMORY_BREAKDOWN = "memory_breakdown"
 MEMORY_BREAKDOWN_DEFAULT = False
 

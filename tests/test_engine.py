@@ -248,3 +248,48 @@ class TestGradAccumDtype:
             deepspeed_tpu.DeepSpeedTPUConfig(
                 {"train_micro_batch_size_per_gpu": 1,
                  "data_types": {"grad_accum_dtype": "fp8"}})
+
+
+class TestCheckNumerics:
+    """`check_numerics` debug mode (SURVEY §5 determinism/debug lever):
+    fail fast with step + leaf names instead of training on NaNs."""
+
+    def _engine(self, check, blowup):
+        import deepspeed_tpu
+
+        def loss_fn(p, b, r):
+            # loss blows up via the params themselves after a huge update
+            return jnp.mean((b["x"] @ p["w"]) ** 2)
+
+        params = {"w": jnp.ones((4, 4), jnp.float32)}
+        cfg = {"train_micro_batch_size_per_gpu": 2,
+               "gradient_accumulation_steps": 1,
+               "optimizer": {"type": "SGD",
+                             "params": {"lr": 1e30 if blowup else 1e-2}},
+               "zero_optimization": {"stage": 0}}
+        if check:
+            cfg["check_numerics"] = True
+        e, _, _, _ = deepspeed_tpu.initialize(loss_fn=loss_fn,
+                                              params=params, config=cfg)
+        return e
+
+    def test_raises_on_nonfinite(self, eight_devices):
+        e = self._engine(check=True, blowup=True)
+        batch = {"x": np.full((1, 2, 4), 1e20, np.float32)}
+        with pytest.raises(FloatingPointError, match="check_numerics"):
+            for _ in range(4):
+                e.train_batch(batch)
+
+    def test_off_by_default_stays_silent(self, eight_devices):
+        e = self._engine(check=False, blowup=True)
+        batch = {"x": np.full((1, 2, 4), 1e20, np.float32)}
+        for _ in range(3):
+            loss = e.train_batch(batch)   # silently inf/nan, no raise
+        assert not np.isfinite(float(loss))
+
+    def test_clean_run_unaffected(self, eight_devices):
+        e = self._engine(check=True, blowup=False)
+        batch = {"x": np.ones((1, 2, 4), np.float32)}
+        losses = [float(e.train_batch(batch)) for _ in range(3)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] <= losses[0]
