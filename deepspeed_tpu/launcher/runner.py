@@ -19,6 +19,7 @@ import os
 import shlex
 import time
 import subprocess
+import tempfile
 import sys
 from collections import OrderedDict
 from typing import Dict, List, Optional
@@ -44,7 +45,8 @@ def parse_args(args=None):
                         default=DEFAULT_MASTER_PORT)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "pdsh", "local", "openmpi", "mpich"],
+                        choices=["ssh", "pdsh", "local", "openmpi", "mpich",
+                                 "mvapich"],
                         help="Multi-node backend")
     parser.add_argument("--force_multi", action="store_true",
                         help="Treat as multi-node even for one host")
@@ -172,6 +174,20 @@ def build_mpi_command(active: "OrderedDict[str, List[int]]", args,
                "--map-by", "ppr:1:node"]
         for k, v in env_exports.items():
             cmd += ["-x", f"{k}={v}"]
+    elif args.launcher == "mvapich":
+        # Reference MVAPICHRunner (multinode_runner.py:141): a hydra-style
+        # mpirun with a hostfile and the MV2_* environment; the CUDA knobs
+        # (MV2_USE_CUDA/SUPPORT_DL) have no TPU role and are dropped.
+        fd, hostfile = tempfile.mkstemp(prefix="dstpu_mvapich_hosts_")
+        with os.fdopen(fd, "w") as f:
+            f.write("\n".join(hosts) + "\n")
+        env_exports = dict(env_exports)
+        env_exports.setdefault("MV2_SMP_USE_CMA", "0")
+        env_exports.setdefault("MV2_DEBUG_SHOW_BACKTRACE", "1")
+        cmd = ["mpirun", "-np", str(len(hosts)),
+               "-hostfile", hostfile, "-ppn", "1"]
+        for k, v in env_exports.items():
+            cmd += ["-env", k, v]
     else:  # mpich
         cmd = ["mpirun", "-np", str(len(hosts)),
                "-hosts", ",".join(hosts), "-ppn", "1"]
@@ -221,7 +237,7 @@ def main(args=None):
         result = subprocess.run(cmd, env={**os.environ, **env})
         sys.exit(result.returncode)
 
-    if args.launcher in ("openmpi", "mpich"):
+    if args.launcher in ("openmpi", "mpich", "mvapich"):
         cmd = build_mpi_command(active, args, env)
         logger.info("mpi launch: %s", " ".join(map(shlex.quote, cmd)))
         result = subprocess.run(cmd, env={**os.environ, **env})

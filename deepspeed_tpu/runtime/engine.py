@@ -301,6 +301,7 @@ class TPUEngine:
             batch_size=self.train_batch_size,
             steps_per_output=self.steps_per_print)
         self._micro_in_window = 0
+        self._pending_micro = []
         self._last_loss = None
         self.global_steps = 0
         self.micro_steps = 0
@@ -463,8 +464,9 @@ class TPUEngine:
         """Step functions for the offloaded optimizer tier: a device-side
         jitted micro-batch scan producing (sharded) grads + overflow/norm
         scalars, then the host/NVMe optimizer step, then compute-dtype params
-        placed back onto the mesh. ``train_batch()`` only — per-microbatch
-        forward/backward would bounce host transfers per micro step."""
+        placed back onto the mesh. Prefer ``train_batch()``; reference-
+        style forward/backward/step loops work via the stash-and-fuse shim
+        (``_compat_forward``) at one extra forward per micro-batch."""
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         fp16 = cfg.fp16.enabled
@@ -806,7 +808,8 @@ class TPUEngine:
         (onebit/adam.py:98) — and the elementwise optimizer apply runs in
         GSPMD-auto mode, where ZeRO-1 optimizer-state sharding composes as
         an ordinary placement policy. Restrictions: ZeRO stage 0/1,
-        ``train_batch()`` only (no per-microbatch forward/backward).
+        Prefer ``train_batch()``; reference-style loops run via the
+        stash-and-fuse shim (``_compat_forward``).
         ``gradient_clipping`` applies inside the shard_map via a psum'd
         rank-RMS norm (see below)."""
         cfg = self.config
@@ -1016,10 +1019,7 @@ class TPUEngine:
     def forward(self, batch):
         """Compute loss and accumulate grads for one micro-batch."""
         if self._micro_step is None:
-            raise RuntimeError(
-                "this configuration requires the fused train_batch() path "
-                "(1-bit optimizers accumulate local grads inside one step; "
-                "offloaded optimizers batch the host round-trip per step)")
+            return self._compat_forward(batch)
         if self.wall_clock_breakdown:
             self.timers("forward").start()
         if self.progressive_layer_drop is not None and isinstance(batch, dict):
@@ -1031,6 +1031,33 @@ class TPUEngine:
         self._last_loss = loss
         if self.wall_clock_breakdown:
             self.timers("forward").stop()
+        return loss
+
+    def _compat_forward(self, batch):
+        """Reference-style forward() for fused-only configurations (1-bit
+        optimizers, offloaded tiers): the micro-batch is STASHED host-side
+        and the real fwd+bwd+sync runs as ONE fused program at the GAS
+        boundary inside step() — lifting the former train_batch()-only
+        restriction (the reference runs 1-bit under its ordinary engine
+        loop, onebit/adam.py). The returned loss is this micro-batch's
+        deterministic (dropout-off) forward; the training loss of the
+        fused step lands in ``engine._last_loss`` after step()."""
+        gas = self.gradient_accumulation_steps
+        stashed = jax.tree_util.tree_map(np.asarray, batch)
+        if len(self._pending_micro) > self._micro_in_window:
+            # The previous forward() was never backward()'d — an eval-style
+            # probe (reference loops call engine(batch) for validation too).
+            # It contributes no gradient: replace it instead of wedging the
+            # window.
+            self._pending_micro[-1] = stashed
+        elif len(self._pending_micro) >= gas:
+            raise RuntimeError(
+                f"forward() called more than gradient_accumulation_steps="
+                f"{gas} times without an intervening step()")
+        else:
+            self._pending_micro.append(stashed)
+        loss = self.eval_batch(batch)
+        self._last_loss = loss
         return loss
 
     def backward(self, loss=None, allreduce_gradients: bool = True):
@@ -1047,6 +1074,17 @@ class TPUEngine:
     def step(self):
         """Optimizer step at GAS boundary (reference engine.step :1302)."""
         if not self.is_gradient_accumulation_boundary():
+            return
+        if self._apply_step is None:
+            # Fused-only configuration: run the whole window (stashed by
+            # _compat_forward) as one fused program now.
+            batches = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *self._pending_micro)
+            self._pending_micro = []
+            self._micro_in_window = 0
+            micro_before = self.micro_steps   # backward() already counted
+            self.train_batch(batches)
+            self.micro_steps = micro_before
             return
         if self.wall_clock_breakdown:
             self.timers("step").start()
@@ -1153,6 +1191,7 @@ class TPUEngine:
     def train_batch(self, batches) -> jax.Array:
         """Fused full step: ``batches`` is a pytree whose leaves have leading
         dim gradient_accumulation_steps (one entry per micro-batch)."""
+        self._pending_micro = []   # direct call supersedes any stashed loop
         self.tput_timer.start()
         batches = self.put_batch(self._inject_pld(self._stash_moq_probe(batches)),
                                  leading_gas_dim=True)

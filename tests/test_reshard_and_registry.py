@@ -104,6 +104,23 @@ class TestMpiLauncher:
         assert "-hosts" in cmd and "h0,h1" in cmd
         assert "-genv" in cmd
 
+    def test_mvapich_command(self):
+        """Reference MVAPICHRunner (multinode_runner.py:141): hydra mpirun
+        with a hostfile and MV2_* env (CUDA knobs dropped on TPU)."""
+        from deepspeed_tpu.launcher.runner import build_mpi_command
+
+        active = OrderedDict([("h0", [0]), ("h1", [0])])
+        cmd = build_mpi_command(active, self._args("mvapich"),
+                                {"JAX_X": "1"})
+        assert cmd[0] == "mpirun"
+        assert "-hostfile" in cmd and "-ppn" in cmd
+        assert "-env" in cmd
+        i = cmd.index("-hostfile")
+        hosts = open(cmd[i + 1]).read().split()
+        assert hosts == ["h0", "h1"]
+        flat = " ".join(cmd)
+        assert "MV2_SMP_USE_CMA" in flat and "MV2_USE_CUDA" not in flat
+
     def test_mpi_rank_from_env(self, monkeypatch):
         from deepspeed_tpu.launcher.launch import mpi_rank
 
