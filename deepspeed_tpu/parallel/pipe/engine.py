@@ -42,6 +42,13 @@ class PipelineEngine(TPUEngine):
             raise ValueError(
                 "ZeRO-2/3 are incompatible with pipeline parallelism "
                 "(reference pipe/engine.py:56); use ZeRO-0/1")
+        if config.pld.enabled:
+            raise ValueError(
+                "progressive_layer_drop is not supported under the "
+                "PipelineEngine: the pipelined block path does not consume "
+                "pld_theta (the per-layer drop gates live in the flat "
+                "model families) — it would silently train with layer "
+                "drop inert")
         self.pipe_model = pipe_model
         # Validate divisibility BEFORE state placement so the user sees a
         # clear error instead of a pjit sharding failure.

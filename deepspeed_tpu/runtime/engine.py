@@ -263,12 +263,6 @@ class TPUEngine:
                 "CsrTensor utility")
         self.progressive_layer_drop = None
         if config.pld.enabled:
-            if getattr(self.optimizer, "needs_local_grads", False):
-                raise ConfigError(
-                    "progressive_layer_drop with a 1-bit optimizer is not "
-                    "supported: the local-grad shard_map step applies one "
-                    "batch spec to every leaf and cannot carry the "
-                    "pld_theta scalar")
             from deepspeed_tpu.runtime.progressive_layer_drop import \
                 ProgressiveLayerDrop
             self.progressive_layer_drop = ProgressiveLayerDrop(
@@ -899,18 +893,43 @@ class TPUEngine:
 
         # Batch spec: honor the engine's batch_spec, keeping only the
         # manual (data-like) axes (other axes stay GSPMD-auto and may not
-        # appear in the shard_map's specs).
-        batch_in_spec = PartitionSpec(
-            None, *tuple(manual_restrict(self.batch_spec)))
+        # appear in the shard_map's specs). Specs are PER LEAF, truncated
+        # to the leaf's rank (mirroring put_batch): a low-rank side input
+        # like PLD's per-micro-step theta vector [gas] rides replicated —
+        # this is what lets progressive_layer_drop compose with the 1-bit
+        # path. The shard_map is therefore constructed at TRACE time,
+        # inside the jitted train_step, where the batch tree is known.
+        base_batch_entries = (None,) + tuple(manual_restrict(self.batch_spec))
         rep = PartitionSpec()
-        mapped = shard_map(
-            phase_a, mesh=mesh,
-            in_specs=(param_in_specs, param_in_specs, param_in_specs,
-                      we_specs, se_specs, rep, rep, rep, batch_in_spec),
-            out_specs=(rep, param_in_specs, param_in_specs, we_specs,
-                       se_specs, rep, rep),
-            axis_names=manual_axes,
-            check_vma=False)
+
+        def batch_leaf_spec(x):
+            entries = base_batch_entries[:x.ndim]
+            # Mirror put_batch's graceful degradation: a leaf whose dims
+            # don't divide the mesh axes is REPLICATED (put_batch already
+            # warned and placed it that way), never given a sharded spec
+            # that would fail shard_map's divisibility check at trace time.
+            for d, e in zip(x.shape, entries):
+                parts = e if isinstance(e, tuple) else ((e,) if e else ())
+                n = 1
+                for a in parts:
+                    n *= mesh.shape.get(a, 1)
+                if n > 1 and d % n:
+                    return PartitionSpec(*([None] * x.ndim))
+            return PartitionSpec(*entries)
+
+        def run_phase_a(params, grad_acc, m, we, se, step, sub, scale,
+                        batches):
+            batch_specs = jax.tree_util.tree_map(batch_leaf_spec, batches)
+            mapped = shard_map(
+                phase_a, mesh=mesh,
+                in_specs=(param_in_specs, param_in_specs, param_in_specs,
+                          we_specs, se_specs, rep, rep, rep, batch_specs),
+                out_specs=(rep, param_in_specs, param_in_specs, we_specs,
+                           se_specs, rep, rep),
+                axis_names=manual_axes,
+                check_vma=False)
+            return mapped(params, grad_acc, m, we, se, step, sub, scale,
+                          batches)
 
         opt_shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), self.opt_state_specs_full)
@@ -923,9 +942,10 @@ class TPUEngine:
             rng, sub = jax.random.split(state.rng)
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
             opt = state.opt_state
-            loss, m_new, g_dense, we_new, se_new, overflow, norm = mapped(
-                state.params, state.grad_acc, opt.m, opt.worker_error,
-                opt.server_error, opt.step, sub, scale, batches)
+            loss, m_new, g_dense, we_new, se_new, overflow, norm = \
+                run_phase_a(
+                    state.params, state.grad_acc, opt.m, opt.worker_error,
+                    opt.server_error, opt.step, sub, scale, batches)
             # GSPMD-auto apply: ZeRO-1 places m/v sharded (opt_specs); the
             # resulting gather/slice collectives ride the ICI data axis.
             new_params, new_opt = optimizer.finish_step(

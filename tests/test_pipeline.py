@@ -346,3 +346,23 @@ class TestPipelineComputeAccounting:
         inner = find_scans(body, [])
         assert inner and inner[0].params["length"] == L // S
         assert count_dots(body) == 1, count_dots(body)
+
+
+class TestPipelinePLDGuard:
+    def test_pld_rejected(self, eight_devices):
+        """PLD's drop gates live in the flat families; the pipelined block
+        path never sees pld_theta — reject loudly instead of training
+        with layer drop silently inert."""
+        cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=32,
+                        num_layers=4, num_heads=2, dropout_rate=0.0,
+                        dtype=jnp.float32)
+        pm = gpt_pipe_model(cfg)
+        mesh = build_mesh(data=4, pipe=2)
+        ds = DeepSpeedTPUConfig({
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "progressive_layer_drop": {"enabled": True}})
+        with pytest.raises(ValueError, match="progressive_layer_drop"):
+            PipelineEngine(pm, ds, mesh=mesh)
