@@ -149,13 +149,6 @@ class TestPLD:
         # theta=0.1 drops most deep layers; losses must differ measurably
         assert abs(l_pld - l_plain) > 1e-6
 
-    def test_pld_with_onebit_rejected(self):
-        with pytest.raises(ConfigError, match="1-bit"):
-            build({"optimizer": {"type": "OneBitAdam",
-                                 "params": {"lr": 1e-3}},
-                   "fp16": {"enabled": True},
-                   "progressive_layer_drop": {"enabled": True}})
-
     def test_pld_injected_on_forward_path(self, rng):
         """The reference-parity forward/backward/step loop must also see
         pld_theta (review regression: was train_batch-only)."""
