@@ -21,11 +21,21 @@ apply exactly as the reference's BERT configs do (large effective batches).
 """
 
 import json
+import os
 import sys
 import time
+import traceback
 
 import jax
 import numpy as np
+
+# Partial results land here after EVERY completed section so a transient
+# tunnel failure (the round-4 driver run died on a dropped remote_compile
+# connection ~2 min in) can never zero the whole record: whatever rows
+# finished are already on disk, and main() exits 0 with those rows on
+# stdout regardless of later sections failing.
+PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_partial.json")
 
 BASELINE_BERT_SEQ128 = 272.0   # samples/s, 1x V100, fused kernels
 BASELINE_BERT_SEQ512 = 52.0    # samples/s, 1x V100
@@ -202,6 +212,66 @@ def bench_gpt2_long(steps, warmup, sparse: bool, seq=16384):
     return tokens / dt
 
 
+def _flush_partial(result):
+    try:
+        tmp = PARTIAL_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, PARTIAL_PATH)
+    except OSError as e:  # a full disk must not kill the bench itself
+        log(f"[bench] WARNING: partial flush failed: {e}")
+
+
+def _is_transient(e) -> bool:
+    """Worth a retry? Tunnel/infra failures (the round-4 killer was a
+    dropped remote_compile connection surfacing as JaxRuntimeError) — not
+    deterministic bugs, whose retry would just repeat a multi-minute
+    compile to fail identically. Deterministic runtime errors that ALSO
+    surface as JaxRuntimeError (HBM OOM) are screened by message."""
+    msg = str(e).lower()
+    if any(s in msg for s in ("resource_exhausted", "out of memory", "oom",
+                              "no such file")):
+        return False
+    if isinstance(e, FileNotFoundError):
+        return False
+    try:
+        from jax.errors import JaxRuntimeError
+        if isinstance(e, JaxRuntimeError):
+            return True
+    except ImportError:
+        pass
+    if isinstance(e, (ConnectionError, TimeoutError, OSError)):
+        return True
+    return any(s in msg for s in ("remote_compile", "read body", "tunnel",
+                                  "connection reset", "connection closed",
+                                  "deadline", "unavailable"))
+
+
+def run_section(name, fn, result, retries=1):
+    """Run one bench section; on a transient failure (tunnel
+    JaxRuntimeError & co — see ``_is_transient``) retry once from scratch:
+    sections are self-contained, so a retry just re-traces and re-compiles.
+    A section that fails terminally records its error and the bench moves
+    on: partial evidence beats none."""
+    for attempt in range(retries + 1):
+        try:
+            fn()
+            _flush_partial(result)
+            return True
+        except Exception as e:  # noqa: BLE001 — isolate every section
+            log(f"[bench] section {name!r} attempt {attempt + 1} failed: "
+                f"{type(e).__name__}: {e}")
+            log(traceback.format_exc())
+            result.setdefault("errors", []).append(
+                f"{name}: {type(e).__name__}: {e}")
+            _flush_partial(result)
+            if not _is_transient(e):
+                return False
+    return False
+
+
 def main():
     dev = jax.devices()[0]
     platform = dev.platform
@@ -213,29 +283,59 @@ def main():
     else:
         steps, warmup = 3, 1
 
-    t0 = time.time()
-    sps128, tf128, n_params, sps128_med = bench_bert(
-        seq=128 if on_tpu else 64, micro_bs=32 if on_tpu else 8,
-        gas=8 if on_tpu else 1, steps=steps, warmup=warmup, on_tpu=on_tpu)
-    log(f"[bench] BERT-large seq128: {sps128:.1f} samples/s/chip, "
-        f"{tf128:.1f} TFLOP/s, MFU {tf128 / peak:.1%} "
-        f"({n_params / 1e6:.0f}M params, setup+run {time.time() - t0:.0f}s)")
+    result = {
+        "metric": f"BERT-{'large' if on_tpu else 'tiny'} seq128 ZeRO-2 "
+                  f"pretrain throughput ({platform})",
+        "value": None,
+        "unit": "samples/sec/chip",
+        "vs_baseline": None,
+    }
+    # Evict any stale partial from a previous run so an early hard crash
+    # can't leave old rows masquerading as this run's record.
+    _flush_partial(result)
 
-    sps512 = tf512 = None
-    gpt2_tps = gpt2_tf = None
-    if on_tpu:
+    def sec_bert128():
         t0 = time.time()
-        sps512, tf512, _, sps512_med = bench_bert(seq=512, micro_bs=8, gas=8,
-                                                  steps=steps, warmup=warmup,
-                                                  on_tpu=on_tpu)
+        sps128, tf128, n_params, sps128_med = bench_bert(
+            seq=128 if on_tpu else 64, micro_bs=32 if on_tpu else 8,
+            gas=8 if on_tpu else 1, steps=steps, warmup=warmup, on_tpu=on_tpu)
+        log(f"[bench] BERT-large seq128: {sps128:.1f} samples/s/chip, "
+            f"{tf128:.1f} TFLOP/s, MFU {tf128 / peak:.1%} "
+            f"({n_params / 1e6:.0f}M params, "
+            f"setup+run {time.time() - t0:.0f}s)")
+        result["value"] = round(sps128, 2)
+        result["vs_baseline"] = round(sps128 / BASELINE_BERT_SEQ128, 4)
+        result["tflops"] = round(tf128, 1)
+        result["mfu"] = round(tf128 / peak, 4)
+        # median-of-windows companion (ADVICE r3): drift-inclusive view of
+        # the same run; `value`/`vs_baseline` stay best-of-windows.
+        result["value_median_window"] = round(sps128_med, 2)
+
+    def sec_bert512():
+        t0 = time.time()
+        sps512, tf512, _, sps512_med = bench_bert(
+            seq=512, micro_bs=8, gas=8, steps=steps, warmup=warmup,
+            on_tpu=on_tpu)
         log(f"[bench] BERT-large seq512: {sps512:.1f} samples/s/chip, "
             f"{tf512:.1f} TFLOP/s, MFU {tf512 / peak:.1%} "
             f"({time.time() - t0:.0f}s)")
+        result["bert_seq512_samples_per_sec"] = round(sps512, 2)
+        result["bert_seq512_vs_baseline"] = round(
+            sps512 / BASELINE_BERT_SEQ512, 4)
+        result["bert_seq512_median_window"] = round(sps512_med, 2)
+
+    def sec_gpt2():
         t0 = time.time()
         gpt2_tps, gpt2_tf, gpt2_tps_med = bench_gpt2(steps, warmup, on_tpu)
         log(f"[bench] GPT-2 seq512: {gpt2_tps:.0f} tokens/s/chip, "
             f"{gpt2_tf:.1f} TFLOP/s, MFU {gpt2_tf / peak:.1%} "
             f"({time.time() - t0:.0f}s)")
+        result["gpt2_tokens_per_sec"] = round(gpt2_tps, 0)
+        result["gpt2_vs_baseline"] = round(gpt2_tps / BASELINE_GPT2_TOKENS, 4)
+        result["gpt2_median_window"] = round(gpt2_tps_med, 0)
+        result["gpt2_mfu"] = round(gpt2_tf / peak, 4)
+
+    def sec_gpt2_dropout():
         # Dropout-on variant (r2 VERDICT task 4 "done" criterion): real
         # pretraining configs keep the flash path via in-kernel dropout.
         t0 = time.time()
@@ -244,42 +344,59 @@ def main():
         log(f"[bench] GPT-2 seq512 dropout=0.1: {gpt2_do_tps:.0f} "
             f"tokens/s/chip, {gpt2_do_tf:.1f} TFLOP/s, MFU "
             f"{gpt2_do_tf / peak:.1%} ({time.time() - t0:.0f}s)")
+        result["gpt2_dropout_tokens_per_sec"] = round(gpt2_do_tps, 0)
+        result["gpt2_dropout_mfu"] = round(gpt2_do_tf / peak, 4)
+
+    def sec_long():
         t0 = time.time()
         long_dense = bench_gpt2_long(steps=4, warmup=1, sparse=False)
+        result["gpt2_seq16k_dense_tokens_per_sec"] = round(long_dense, 0)
+        _flush_partial(result)
         long_sparse = bench_gpt2_long(steps=4, warmup=1, sparse=True)
         log(f"[bench] GPT-2 seq16384: dense {long_dense:.0f} tok/s, "
             f"bigbird {long_sparse:.0f} tok/s "
             f"({long_sparse / long_dense:.2f}x, {time.time() - t0:.0f}s)")
-
-    result = {
-        "metric": f"BERT-{'large' if on_tpu else 'tiny'} seq128 ZeRO-2 "
-                  f"pretrain throughput ({platform})",
-        "value": round(sps128, 2),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(sps128 / BASELINE_BERT_SEQ128, 4),
-        "tflops": round(tf128, 1),
-        "mfu": round(tf128 / peak, 4),
-        # median-of-windows companions (ADVICE r3): drift-inclusive view of
-        # the same run; `value`/`vs_baseline` stay best-of-windows.
-        "value_median_window": round(sps128_med, 2),
-    }
-    if sps512 is not None:
-        result["bert_seq512_samples_per_sec"] = round(sps512, 2)
-        result["bert_seq512_vs_baseline"] = round(
-            sps512 / BASELINE_BERT_SEQ512, 4)
-        result["bert_seq512_median_window"] = round(sps512_med, 2)
-    if gpt2_tps is not None:
-        result["gpt2_tokens_per_sec"] = round(gpt2_tps, 0)
-        result["gpt2_vs_baseline"] = round(gpt2_tps / BASELINE_GPT2_TOKENS, 4)
-        result["gpt2_median_window"] = round(gpt2_tps_med, 0)
-        result["gpt2_mfu"] = round(gpt2_tf / peak, 4)
-        result["gpt2_dropout_tokens_per_sec"] = round(gpt2_do_tps, 0)
-        result["gpt2_dropout_mfu"] = round(gpt2_do_tf / peak, 4)
-        result["gpt2_seq16k_dense_tokens_per_sec"] = round(long_dense, 0)
         result["gpt2_seq16k_bigbird_tokens_per_sec"] = round(long_sparse, 0)
         result["gpt2_seq16k_sparse_speedup"] = round(
             long_sparse / long_dense, 3)
+
+    sections = [("bert128", sec_bert128)]
+    if on_tpu:
+        sections += [("bert512", sec_bert512), ("gpt2", sec_gpt2),
+                     ("gpt2_dropout", sec_gpt2_dropout), ("long16k", sec_long)]
+    n_ok = 0
+    for name, fn in sections:
+        n_ok += bool(run_section(name, fn, result))
+
+    if result["value"] is None:
+        # Headline fallback: if the BERT-128 section failed both attempts,
+        # promote the best surviving row so `value` is never null while
+        # data is present elsewhere.
+        for vkey, bkey, metric, unit in (
+                ("gpt2_tokens_per_sec", "gpt2_vs_baseline",
+                 "GPT-2 seq512 ZeRO-2 pretrain throughput",
+                 "tokens/sec/chip"),
+                ("bert_seq512_samples_per_sec", "bert_seq512_vs_baseline",
+                 "BERT-large seq512 ZeRO-2 pretrain throughput",
+                 "samples/sec/chip"),
+                ("gpt2_dropout_tokens_per_sec", None,
+                 "GPT-2 seq512 dropout-on pretrain throughput",
+                 "tokens/sec/chip"),
+                ("gpt2_seq16k_dense_tokens_per_sec", None,
+                 "GPT-2 seq16384 pretrain throughput", "tokens/sec/chip")):
+            if result.get(vkey):
+                result["metric"] = f"{metric} ({platform})"
+                result["unit"] = unit
+                result["value"] = result[vkey]
+                result["vs_baseline"] = result[bkey] if bkey else None
+                break
+
+    _flush_partial(result)
     print(json.dumps(result))
+    # Exit 0 iff ANY section produced a row: partial evidence is a valid
+    # record, but a zero-row run must stay loudly distinguishable from
+    # success in the driver's rc-based log (the round-4 rc=1 signal).
+    sys.exit(0 if n_ok else 1)
 
 
 if __name__ == "__main__":
