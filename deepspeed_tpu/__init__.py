@@ -86,6 +86,27 @@ def initialize(args=None,
                 "ops.sparse_attention.SparseSelfAttention yourself "
                 "(see ops/sparse_attention/utils.py)")
 
+    sparse_grads_handled = False
+    if cfg.sparse_gradients_enabled and model is not None \
+            and loss_fn is None \
+            and hasattr(model, "cfg") \
+            and hasattr(model.cfg, "sparse_embedding_grad"):
+        # Config-driven sparse-gradient surgery (reference engine.py:1530:
+        # `sparse_gradients: true` makes embedding grads travel as CSR —
+        # here the family's embedding_lookup VJP exchanges touched rows
+        # over the data axes instead; frozen-dataclass replace, like the
+        # sparse_attention surgery above).
+        from dataclasses import replace as _dc_replace
+
+        if not model.cfg.sparse_embedding_grad:
+            model = type(model)(cfg=_dc_replace(model.cfg,
+                                                sparse_embedding_grad=True))
+        sparse_grads_handled = True
+        from deepspeed_tpu.utils.logging import log_dist
+        log_dist("sparse_gradients: embedding grads exchange touched rows "
+                 "over the data axes (ops/embedding.py row-sparse VJP)",
+                 ranks=[0])
+
     if cfg.zero_config.offload_param.enabled and loss_fn is not None:
         raise ValueError(
             "offload_param cannot stream an opaque loss_fn (no per-block "
@@ -154,7 +175,9 @@ def initialize(args=None,
     engine = TPUEngine(loss_fn=loss_fn, params=params, config=cfg, mesh=mesh,
                        param_partition_specs=param_partition_specs,
                        optimizer=optimizer, lr_scheduler=lr_scheduler,
-                       rng_seed=rng_seed, **kwargs)
+                       rng_seed=rng_seed,
+                       sparse_gradients_handled=sparse_grads_handled,
+                       **kwargs)
 
     dataloader = None
     if training_data is not None:

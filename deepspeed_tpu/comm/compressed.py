@@ -27,7 +27,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from deepspeed_tpu.parallel.mesh import DATA_AXIS
 
-_POW2 = 2 ** jnp.arange(8, dtype=jnp.uint8)
+# numpy, NOT jnp: a module-level jnp value becomes a leaked tracer if this
+# module is first imported inside a jit trace (e.g. the sparse-grad VJP's
+# lazy `from deepspeed_tpu.comm.sparse import ...`).
+import numpy as _np
+
+_POW2 = 2 ** _np.arange(8, dtype=_np.uint8)
 
 
 def pack_signs(bits: jax.Array) -> jax.Array:

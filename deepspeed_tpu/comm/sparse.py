@@ -43,11 +43,12 @@ def scatter_rows(ids: jax.Array, rows: jax.Array, vocab: int) -> jax.Array:
 
 
 def row_sparse_allreduce(ids: jax.Array, rows: jax.Array, vocab: int,
-                         axis: str = DATA_AXIS,
+                         axis=DATA_AXIS,
                          mean: bool = True) -> jax.Array:
-    """Inside a manual shard_map over ``axis``: gather every rank's
-    (ids, rows) and scatter-add into the dense [V, D] mean gradient —
-    wire bytes scale with touched rows, not vocab."""
+    """Inside a manual shard_map over ``axis`` (one name or a tuple of
+    names): gather every rank's (ids, rows) and scatter-add into the dense
+    [V, D] mean gradient — wire bytes scale with touched rows, not
+    vocab."""
     all_ids = jax.lax.all_gather(ids, axis, axis=0, tiled=True)
     all_rows = jax.lax.all_gather(rows, axis, axis=0, tiled=True)
     dense = scatter_rows(all_ids, all_rows, vocab)

@@ -47,6 +47,9 @@ class BertConfig:
     sparse_attention: Any = None
     # Counter-hash activation dropout (ops/dropout.py) — see GPTConfig.
     fast_dropout: bool = True
+    # Row-sparse cross-rank embedding-grad exchange (`sparse_gradients:
+    # true`) — see GPTConfig.sparse_embedding_grad.
+    sparse_embedding_grad: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -139,7 +142,12 @@ class BertModel(nn.Module):
                          (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
         tt = batch.get("token_type_ids")
         tt_emb = tte[tt] if tt is not None else tte[0][None, None]
-        x = (wte[ids] + wpe[:s][None] + tt_emb).astype(cfg.dtype)
+        from deepspeed_tpu.ops.embedding import (embedding_lookup,
+                                                 resolve_sparse_grad_axes)
+        tok = embedding_lookup(
+            wte, ids, sparse_grad_axes=resolve_sparse_grad_axes(
+                cfg.sparse_embedding_grad))
+        x = (tok + wpe[:s][None] + tt_emb).astype(cfg.dtype)
         if not cfg.pre_layer_norm:
             x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
                              name="ln_emb")(x).astype(cfg.dtype)
@@ -161,8 +169,10 @@ class BertModel(nn.Module):
         for i in range(cfg.num_layers):
             y = layer(cfg, name=f"layer_{i}")(x, attn_mask, deterministic)
             if pld_theta is not None and not deterministic:
-                p_keep = 1.0 - (i / cfg.num_layers) * (1.0 - pld_theta)
-                gate = jax.random.bernoulli(self.make_rng("dropout"), p_keep)
+                from deepspeed_tpu.runtime.progressive_layer_drop import \
+                    pld_keep_gate
+                gate = pld_keep_gate(self.make_rng("dropout"), i,
+                                     cfg.num_layers, pld_theta)
                 y = jnp.where(gate, y, x)
             x = y
         if cfg.pre_layer_norm:

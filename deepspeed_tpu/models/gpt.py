@@ -63,10 +63,26 @@ class GPTConfig:
     # Embedding-table gradient via one-hot MXU matmul instead of XLA's
     # serialized TPU scatter-add (ops/embedding.py; PROFILE.md r3 lever).
     embed_grad_matmul: bool = False
+    # Row-sparse cross-rank embedding-grad exchange (config
+    # `sparse_gradients: true` — reference engine.py:1530-1586):
+    # True = exchange over the data-like mesh axes; or an explicit tuple
+    # of axis names. deepspeed_tpu.initialize() injects this from the
+    # engine config automatically.
+    sparse_embedding_grad: Any = None
     # Counter-hash activation dropout (ops/dropout.py) instead of flax's
     # threefry bernoulli — the reference's fused-dropout economy
     # (csrc/transformer/dropout_kernels.cu); measured A/B in PROFILE.md.
     fast_dropout: bool = True
+    # Fused LayerNorm+projection Pallas kernel at the two pre-LN sites
+    # (LN1+QKV and LN2+fc1+GELU) — the reference's fused-block economy
+    # (csrc/transformer/ds_transformer_cuda.cpp:147). OFF by default:
+    # measured end-to-end LOSS on v5e despite winning isolated micro A/Bs
+    # (r5, tools/probe_fused_r5.py: qkv-only 0.93x, mlp-only 0.95x,
+    # both 0.90x of baseline — the pallas_call is an XLA fusion barrier,
+    # and the surrounding transposes/adds XLA previously fused into the
+    # matmuls become standalone HBM passes; PROFILE.md r5). Values:
+    # True/"auto" = both sites, "qkv"/"mlp" = one site, False = unfused.
+    fused_ln: Any = False
     # Block-sparse attention config dict (the DeepSpeed `sparse_attention`
     # block: mode/block/num_local_blocks/...). When set, training attention
     # routes through ops.sparse_attention (long-sequence O(s·√s) path);
@@ -112,6 +128,34 @@ GPT_CONFIGS: Dict[str, GPTConfig] = {
 }
 
 
+def _use_fused_ln(cfg, x) -> frozenset:
+    """Dispatch for the fused LN+projection path (GPTConfig.fused_ln):
+    returns the set of fused sites ("qkv", "mlp"). "auto" = both on TPU
+    when shapes tile; True forces both (Pallas interpret off-TPU — parity
+    tests); "qkv"/"mlp" select one site; False = unfused flax modules."""
+    mode = getattr(cfg, "fused_ln", False)
+    if mode is False or mode is None:
+        return frozenset()
+    from deepspeed_tpu.ops.transformer.fused import ln_matmul_ok
+
+    n = x.shape[0] * x.shape[1]
+    ok = (ln_matmul_ok(n, cfg.hidden_size, 3 * cfg.hidden_size)
+          and ln_matmul_ok(n, cfg.hidden_size,
+                           cfg.mlp_ratio * cfg.hidden_size))
+    if not ok:
+        return frozenset()
+    if mode == "auto":
+        if jax.devices()[0].platform != "tpu":
+            return frozenset()
+        return frozenset(("qkv", "mlp"))
+    if mode is True:
+        return frozenset(("qkv", "mlp"))
+    if mode in ("qkv", "mlp"):
+        return frozenset((mode,))
+    raise ValueError(f"unknown fused_ln value {mode!r}: expected False, "
+                     "True, 'auto', 'qkv', or 'mlp'")
+
+
 class GPTBlock(nn.Module):
     """Pre-LN transformer block (attention + MLP or MoE FFN).
 
@@ -136,10 +180,21 @@ class GPTBlock(nn.Module):
         cfg = self.cfg
         d = cfg.hidden_size
         dt = cfg.dtype
+        fused = _use_fused_ln(cfg, x)
 
-        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
-                         name="ln_1")(x).astype(dt)
-        qkv = nn.Dense(3 * d, dtype=dt, name="c_attn")(h)
+        if fused:
+            from deepspeed_tpu.ops.transformer.fused import (DenseParams,
+                                                             LNParams,
+                                                             ln_matmul)
+        if "qkv" in fused:
+            scale1, lnb1 = LNParams(d, name="ln_1")()
+            wk, wb = DenseParams(d, 3 * d, name="c_attn")()
+            qkv = ln_matmul(x, scale1, lnb1, wk.astype(dt), wb.astype(dt),
+                            eps=cfg.layer_norm_epsilon)
+        else:
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                             dtype=jnp.float32, name="ln_1")(x).astype(dt)
+            qkv = nn.Dense(3 * d, dtype=dt, name="c_attn")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         b, s = q.shape[0], q.shape[1]
         shape = (b, s, cfg.num_heads, cfg.head_dim)
@@ -189,21 +244,28 @@ class GPTBlock(nn.Module):
         o = _dropout_mod(cfg)(cfg.dropout_rate, deterministic=deterministic)(o)
         x = x + o
 
-        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
-                         name="ln_2")(x).astype(dt)
         aux = None
-        if self.moe:
-            from deepspeed_tpu.moe import MoE, MoEConfig
-
-            h, aux = MoE(MoEConfig(
-                hidden_size=d, num_experts=cfg.moe_experts, k=cfg.moe_k,
-                capacity_factor=cfg.moe_capacity_factor,
-                expert_intermediate=cfg.mlp_ratio * d, dtype=dt),
-                name="moe")(h, deterministic=deterministic)
-        else:
-            h = nn.Dense(cfg.mlp_ratio * d, dtype=dt, name="c_fc")(h)
-            h = nn.gelu(h, approximate=True)
+        if "mlp" in fused and not self.moe:
+            scale2, lnb2 = LNParams(d, name="ln_2")()
+            wf, bf2 = DenseParams(d, cfg.mlp_ratio * d, name="c_fc")()
+            h = ln_matmul(x, scale2, lnb2, wf.astype(dt), bf2.astype(dt),
+                          eps=cfg.layer_norm_epsilon, activation="gelu")
             h = nn.Dense(d, dtype=dt, name="mlp_proj")(h)
+        else:
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                             dtype=jnp.float32, name="ln_2")(x).astype(dt)
+            if self.moe:
+                from deepspeed_tpu.moe import MoE, MoEConfig
+
+                h, aux = MoE(MoEConfig(
+                    hidden_size=d, num_experts=cfg.moe_experts, k=cfg.moe_k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    expert_intermediate=cfg.mlp_ratio * d, dtype=dt),
+                    name="moe")(h, deterministic=deterministic)
+            else:
+                h = nn.Dense(cfg.mlp_ratio * d, dtype=dt, name="c_fc")(h)
+                h = nn.gelu(h, approximate=True)
+                h = nn.Dense(d, dtype=dt, name="mlp_proj")(h)
         h = _dropout_mod(cfg)(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
         out = (x, kv_cache) if kv_cache is not None else x
@@ -247,8 +309,12 @@ class GPT(nn.Module):
             pe = wpe[:s][None]
         else:
             pe = jnp.take(wpe, pos + jnp.arange(s), axis=0)[None]
-        from deepspeed_tpu.ops.embedding import embedding_lookup
-        tok = embedding_lookup(wte, ids, matmul_grad=cfg.embed_grad_matmul)
+        from deepspeed_tpu.ops.embedding import (embedding_lookup,
+                                                 resolve_sparse_grad_axes)
+        tok = embedding_lookup(
+            wte, ids, matmul_grad=cfg.embed_grad_matmul,
+            sparse_grad_axes=resolve_sparse_grad_axes(
+                cfg.sparse_embedding_grad))
         x = tok.astype(cfg.dtype) + pe.astype(cfg.dtype)
         x = _dropout_mod(cfg)(cfg.dropout_rate, deterministic=deterministic)(x)
 
@@ -300,9 +366,10 @@ class GPT(nn.Module):
                 if is_moe(i):
                     y, aux_i = y
                 if pld_theta is not None and not deterministic:
-                    p_keep = 1.0 - (i / cfg.num_layers) * (1.0 - pld_theta)
-                    gate = jax.random.bernoulli(self.make_rng("dropout"),
-                                                p_keep)
+                    from deepspeed_tpu.runtime.progressive_layer_drop import \
+                        pld_keep_gate
+                    gate = pld_keep_gate(self.make_rng("dropout"), i,
+                                         cfg.num_layers, pld_theta)
                     y = jnp.where(gate, y, x)
                     if aux_i is not None:
                         # a PLD-dropped MoE layer contributed nothing —

@@ -43,10 +43,90 @@ def _lookup_bwd(res, g):
 _lookup_matmul_grad.defvjp(_lookup_fwd, _lookup_bwd)
 
 
+def _make_lookup_sparse(mesh, axes):
+    """Embedding lookup whose VJP exchanges TOUCHED ROWS over the data
+    axes instead of letting GSPMD all-reduce the dense [V, D] cotangent —
+    the engine-automatic ``sparse_gradients`` path (reference
+    deepspeed/runtime/engine.py:1530-1586 exchanges CSR index/value
+    tensors; here the exchange is an all_gather of (ids, per-token rows)
+    inside the op's custom VJP, wire bytes ∝ batch tokens, then a local
+    scatter-add rebuilds the dense gradient on every rank)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.comm.sparse import row_sparse_allreduce, scatter_rows
+
+    @jax.custom_vjp
+    def lookup(table, ids):
+        return jnp.take(table, ids, axis=0)
+
+    def fwd(table, ids):
+        return jnp.take(table, ids, axis=0), (table, ids)
+
+    def bwd(res, g):
+        table, ids = res
+        v, d = table.shape
+        flat_ids = ids.reshape(ids.shape[0], -1)
+        rows = g.reshape(g.shape[0], -1, d).astype(jnp.float32)
+        if mesh is None or all(mesh.shape.get(a, 1) <= 1 for a in axes):
+            dense = scatter_rows(flat_ids.reshape(-1),
+                                 rows.reshape(-1, d), v)
+        else:
+            spec = P(axes if len(axes) > 1 else axes[0])
+
+            def body(i, r):
+                # Cotangents SUM over data shards (GSPMD convention);
+                # the loss's global-batch mean already divided.
+                return row_sparse_allreduce(i.reshape(-1),
+                                            r.reshape(-1, d), v,
+                                            axis=axes, mean=False)
+
+            dense = shard_map(body, mesh=mesh, in_specs=(spec, spec),
+                              out_specs=P(), axis_names=set(axes),
+                              check_vma=False)(flat_ids, rows)
+        return dense.astype(table.dtype), np.zeros(ids.shape,
+                                                   jax.dtypes.float0)
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
+
+
+def resolve_sparse_grad_axes(setting):
+    """Model-config helper: ``True`` -> the data-like axes of the default
+    mesh with size > 1 (dcn + data); a tuple passes through; falsy ->
+    None (dense grad path)."""
+    if not setting:
+        return None
+    if setting is True:
+        from deepspeed_tpu.parallel.mesh import (DATA_AXIS, DCN_AXIS,
+                                                 get_default_mesh)
+
+        mesh = get_default_mesh()
+        if mesh is None:
+            return None
+        axes = tuple(a for a in (DCN_AXIS, DATA_AXIS)
+                     if mesh.shape.get(a, 1) > 1)
+        # Size-1 everywhere still routes through the sparse path (local
+        # scatter only) so the config toggle is honored uniformly.
+        return axes or (DATA_AXIS,)
+    return tuple(setting)
+
+
 def embedding_lookup(table: jax.Array, ids: jax.Array,
-                     matmul_grad: bool = False) -> jax.Array:
+                     matmul_grad: bool = False,
+                     sparse_grad_axes=None) -> jax.Array:
     """``table[ids]`` ([V, D] x [...] int -> [..., D]) with a selectable
-    gradient path: XLA scatter-add (default) or the one-hot MXU matmul."""
+    gradient path: XLA scatter-add (default), the one-hot MXU matmul, or —
+    with ``sparse_grad_axes`` (mesh axis names, batch dim 0) — the
+    row-sparse cross-rank exchange (config ``sparse_gradients: true``)."""
+    if sparse_grad_axes:
+        if matmul_grad:
+            raise ValueError("matmul_grad and sparse_grad_axes are "
+                             "mutually exclusive embedding-grad paths")
+        from deepspeed_tpu.parallel.mesh import get_default_mesh
+
+        return _make_lookup_sparse(get_default_mesh(),
+                                   tuple(sparse_grad_axes))(table, ids)
     if matmul_grad:
         return _lookup_matmul_grad(table, ids)
     return jnp.take(table, ids, axis=0)

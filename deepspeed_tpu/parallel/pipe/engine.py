@@ -42,13 +42,13 @@ class PipelineEngine(TPUEngine):
             raise ValueError(
                 "ZeRO-2/3 are incompatible with pipeline parallelism "
                 "(reference pipe/engine.py:56); use ZeRO-0/1")
-        if config.pld.enabled:
+        if config.pld.enabled and not pipe_model.block_takes_layer_idx:
             raise ValueError(
-                "progressive_layer_drop is not supported under the "
-                "PipelineEngine: the pipelined block path does not consume "
-                "pld_theta (the per-layer drop gates live in the flat "
-                "model families) — it would silently train with layer "
-                "drop inert")
+                "progressive_layer_drop under the PipelineEngine needs a "
+                "PipeModel with block_takes_layer_idx=True (the per-layer "
+                "drop gate consumes the global layer index; the in-tree "
+                "gpt_pipe_model provides it) — this custom PipeModel "
+                "would silently train with layer drop inert")
         self.pipe_model = pipe_model
         # Validate divisibility BEFORE state placement so the user sees a
         # clear error instead of a pjit sharding failure.
@@ -95,7 +95,8 @@ class PipelineEngine(TPUEngine):
                         lambda b: pm.aux_fn(compute_params, b))(batches)
             h = pipeline_apply(pm.block_fn, compute_params["blocks"], embeds,
                                mesh, aux=aux, rng=rng, num_microbatches=gas,
-                               remat_blocks=True)
+                               remat_blocks=True,
+                               pass_layer_idx=pm.block_takes_layer_idx)
             losses = jax.vmap(
                 lambda hm, bm: pm.head_fn(compute_params, hm, bm))(h, batches)
             return jnp.mean(losses.astype(jnp.float32))
@@ -165,7 +166,8 @@ class PipelineEngine(TPUEngine):
                 h = pipeline_apply_manual(
                     pm.block_fn, cp["blocks"], embeds, aux, sub,
                     stages=stages, num_microbatches=gas, remat_blocks=True,
-                    broadcast_output=False)
+                    broadcast_output=False,
+                    pass_layer_idx=pm.block_takes_layer_idx)
                 if stages > 1:
                     last = jax.lax.axis_index(PIPE_AXIS) == stages - 1
                     # Zero invalid-rank activations BEFORE the head so the

@@ -68,6 +68,9 @@ class PipeModel:
     params: Any
     num_blocks: int
     aux_fn: Optional[Callable] = None
+    # block_fn takes a 5th arg: the GLOBAL layer index (stage offset +
+    # local position) — needed by per-layer schedules (PLD).
+    block_takes_layer_idx: bool = False
 
     def check(self, pipe_size: int) -> None:
         if self.num_blocks % pipe_size:
@@ -117,14 +120,17 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
     }
 
     def embed_fn(params, batch, rng):
-        from deepspeed_tpu.ops.embedding import embedding_lookup
+        from deepspeed_tpu.ops.embedding import (embedding_lookup,
+                                                 resolve_sparse_grad_axes)
 
         ids = batch["input_ids"]
         s = ids.shape[1]
         emb = params["embed"]
         tok = embedding_lookup(
             emb["wte"], ids,
-            matmul_grad=getattr(cfg, "embed_grad_matmul", False))
+            matmul_grad=getattr(cfg, "embed_grad_matmul", False),
+            sparse_grad_axes=resolve_sparse_grad_axes(
+                getattr(cfg, "sparse_embedding_grad", None)))
         x = tok.astype(cfg.dtype) + emb["wpe"][:s][None].astype(cfg.dtype)
         if rng is not None and cfg.dropout_rate > 0.0:
             keep = jax.random.bernoulli(rng, 1.0 - cfg.dropout_rate, x.shape)
@@ -133,16 +139,39 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
 
     def aux_fn(params, batch):
         am = batch.get("attention_mask")
-        if am is None:
-            return None
         # [mb, S] -> broadcastable [mb, 1, 1, S] attend-mask for GPTBlock.
-        return am[:, None, None, :].astype(jnp.bool_)
+        mask = (None if am is None
+                else am[:, None, None, :].astype(jnp.bool_))
+        theta = batch.get("pld_theta")
+        if theta is None:
+            return mask
+        # Progressive Layer Drop rides as aux so every stage sees the
+        # step's theta (reference threads it through engine.forward,
+        # /root/reference/deepspeed/runtime/engine.py:1085; here the
+        # pipelined schedule delivers it with the microbatch).
+        return {"attn_mask": mask, "pld_theta": jnp.float32(theta)}
 
-    def block_fn(p, x, aux, rng):
+    def _unpack_aux(aux):
+        if isinstance(aux, dict):
+            return aux.get("attn_mask"), aux.get("pld_theta")
+        return aux, None
+
+    def block_fn(p, x, aux, rng, layer_idx=0):
+        mask, theta = _unpack_aux(aux)
         if rng is None or cfg.dropout_rate == 0.0:
-            return block.apply({"params": p}, x, aux, True)
-        return block.apply({"params": p}, x, aux, False,
-                           rngs={"dropout": rng})
+            y = block.apply({"params": p}, x, mask, True)
+        else:
+            y = block.apply({"params": p}, x, mask, False,
+                            rngs={"dropout": rng})
+        if theta is not None and rng is not None:
+            # The SAME keep schedule as the flat families — one shared
+            # implementation so the pipelined trajectory cannot drift.
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                pld_keep_gate
+            gate = pld_keep_gate(jax.random.fold_in(rng, 0x9E37),
+                                 layer_idx, cfg.num_layers, theta)
+            y = jnp.where(gate, y, x)
+        return y
 
     # Final LN through flax's own LayerNorm (same impl/epsilon as the
     # non-pipelined GPT's ln_f) + the model's decode convention (tied einsum
@@ -179,4 +208,4 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
 
     return PipeModel(embed_fn=embed_fn, block_fn=block_fn,
                      head_fn=head_fn, aux_fn=aux_fn, params=params,
-                     num_blocks=cfg.num_layers)
+                     num_blocks=cfg.num_layers, block_takes_layer_idx=True)

@@ -64,7 +64,8 @@ def pipeline_apply_manual(block_fn: Callable,
                           stages: int,
                           num_microbatches: int,
                           remat_blocks: bool = True,
-                          broadcast_output: bool = True) -> jax.Array:
+                          broadcast_output: bool = True,
+                          pass_layer_idx: bool = False) -> jax.Array:
     """The manual-region pipeline body: call INSIDE a shard_map already
     manual over ``pipe`` (``stage_blocks`` leaves carry the local
     ``[L/S, ...]`` shard; ``x_all`` ``[M, mb, ...]`` is pipe-replicated).
@@ -76,20 +77,29 @@ def pipeline_apply_manual(block_fn: Callable,
     engine) use this to keep gradient provenance per stage.
 
     With ``stages == 1`` this degenerates to a scan over blocks per
-    microbatch (no collectives emitted)."""
+    microbatch (no collectives emitted).
+
+    ``pass_layer_idx``: call ``block_fn(p, h, a, k, global_layer_idx)``
+    — the GLOBAL block index (stage offset + local scan index), which
+    per-layer schedules like Progressive Layer Drop need (the flat
+    families read it from the Python loop counter; the reference threads
+    PLD kwargs through engine.forward into each layer,
+    /root/reference/deepspeed/runtime/engine.py:1085)."""
     M = num_microbatches
     fn = jax.checkpoint(block_fn) if remat_blocks else block_fn
+    n_local = jax.tree_util.tree_leaves(stage_blocks)[0].shape[0]
 
-    def stage_apply(h, a, key):
+    def stage_apply(h, a, key, base):
         # Apply this stage's L/S blocks in order (scan keeps the program
         # small; blocks are structurally identical by contract).
         def body(h, xs):
             p, i = xs
             k = None if key is None else jax.random.fold_in(key, i)
+            if pass_layer_idx:
+                return fn(p, h, a, k, base + i), None
             return fn(p, h, a, k), None
 
-        n = jax.tree_util.tree_leaves(stage_blocks)[0].shape[0]
-        h, _ = jax.lax.scan(body, h, (stage_blocks, jnp.arange(n)))
+        h, _ = jax.lax.scan(body, h, (stage_blocks, jnp.arange(n_local)))
         return h
 
     def aux_at(idx):
@@ -102,7 +112,7 @@ def pipeline_apply_manual(block_fn: Callable,
     if stages == 1:
         def per_mb(mb, i):
             key = None if keys is None else jax.random.fold_in(keys, i)
-            return stage_apply(mb, aux_at(i), key)
+            return stage_apply(mb, aux_at(i), key, 0)
 
         if aux_all is None:
             return jax.vmap(per_mb)(x_all, jnp.arange(M))
@@ -144,7 +154,7 @@ def pipeline_apply_manual(block_fn: Callable,
         a = aux_at(jnp.clip(m, 0, M - 1))
         k = (None if keys is None
              else jax.random.fold_in(jax.random.fold_in(keys, t), rank))
-        y = stage_apply(h, a, k)
+        y = stage_apply(h, a, k, rank * n_local)
         buf = jax.lax.ppermute(y, PIPE_AXIS, shift)
         return buf, y
 
@@ -172,7 +182,8 @@ def pipeline_apply(block_fn: Callable,
                    aux: Any = None,
                    rng: Optional[jax.Array] = None,
                    num_microbatches: Optional[int] = None,
-                   remat_blocks: bool = True) -> jax.Array:
+                   remat_blocks: bool = True,
+                   pass_layer_idx: bool = False) -> jax.Array:
     """Run the stacked-block pipeline over microbatches.
 
     block_fn(params_one_block, x, aux_or_None, rng_or_None) -> x
@@ -199,7 +210,8 @@ def pipeline_apply(block_fn: Callable,
     if stages == 1:
         return pipeline_apply_manual(block_fn, blocks_params, x, aux, rng,
                                      stages=1, num_microbatches=M,
-                                     remat_blocks=remat_blocks)
+                                     remat_blocks=remat_blocks,
+                                     pass_layer_idx=pass_layer_idx)
 
     compute_dtype = x.dtype
 
@@ -213,14 +225,16 @@ def pipeline_apply(block_fn: Callable,
         return pipeline_apply_manual(
             block_fn, stage_blocks, x_all.astype(compute_dtype), aux_all,
             keys, stages=stages, num_microbatches=M,
-            remat_blocks=remat_blocks, broadcast_output=True)
+            remat_blocks=remat_blocks, broadcast_output=True,
+            pass_layer_idx=pass_layer_idx)
 
     blocks_treedef = jax.tree_util.tree_structure(blocks_params)
     blocks_ndims = tuple(l.ndim for l in jax.tree_util.tree_leaves(blocks_params))
     aux_treedef = (None if aux is None
                    else jax.tree_util.tree_structure(aux))
     key = (block_fn, mesh, stages, M, remat_blocks, rng is None,
-           blocks_treedef, blocks_ndims, aux_treedef, compute_dtype)
+           blocks_treedef, blocks_ndims, aux_treedef, compute_dtype,
+           pass_layer_idx)
     if key not in _PIPELINE_CACHE:
         def entry(blocks_arg, x_arg, aux_arg, rng_arg):
             return shard_map(

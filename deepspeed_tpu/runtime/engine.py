@@ -131,7 +131,8 @@ class TPUEngine:
                  lr_scheduler: Any = None,
                  batch_spec: Optional[PartitionSpec] = None,
                  rng_seed: int = 0,
-                 donate_state: bool = True):
+                 donate_state: bool = True,
+                 sparse_gradients_handled: bool = False):
         self.config = config
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else build_mesh(
@@ -253,14 +254,17 @@ class TPUEngine:
         self.wall_clock_breakdown = config.wall_clock_breakdown
 
         # --- aux subsystems driven by their config blocks -------------------
-        if config.sparse_gradients_enabled:
+        if config.sparse_gradients_enabled and not sparse_gradients_handled:
             raise ConfigError(
-                "sparse_gradients is not supported on TPU: XLA AD always "
-                "materializes dense gradients and compiles dense "
-                "collectives, so the reference's CSR embedding-gradient "
-                "exchange (csr_tensor.py) has no bandwidth to save here; "
-                "see runtime/sparse_tensor.py for the rationale and the "
-                "CsrTensor utility")
+                "sparse_gradients: this loss path does not declare the "
+                "row-sparse embedding-grad exchange, and the engine cannot "
+                "sparsify behind XLA AD's back (dense cotangents). Either "
+                "pass an in-tree GPT/BERT model to deepspeed_tpu."
+                "initialize() (wired automatically), or set your model "
+                "cfg's sparse_embedding_grad / route the embedding "
+                "through ops.embedding.embedding_lookup(sparse_grad_axes="
+                "...) and construct the engine with "
+                "sparse_gradients_handled=True")
         self.progressive_layer_drop = None
         if config.pld.enabled:
             from deepspeed_tpu.runtime.progressive_layer_drop import \

@@ -20,6 +20,21 @@ recompilation.
 import math
 
 
+def pld_keep_gate(key, layer_idx, num_layers, theta):
+    """The per-layer Bernoulli keep gate — ONE implementation shared by
+    the flat GPT/BERT families and the pipelined block path so their
+    theta schedules cannot drift: keep probability
+    ``p_l = 1 - l/L * (1 - theta)`` (deeper layers drop more).
+    ``layer_idx`` may be a traced scalar (the pipelined scan's global
+    block index). Returns a boolean scalar."""
+    import jax
+    import jax.numpy as jnp
+
+    frac = jnp.asarray(layer_idx, jnp.float32) / num_layers
+    p_keep = 1.0 - frac * (1.0 - theta)
+    return jax.random.bernoulli(key, p_keep)
+
+
 class ProgressiveLayerDrop:
     """Theta schedule (reference progressive_layer_drop.py API parity:
     ``get_state``, ``get_theta``, ``update_state``)."""

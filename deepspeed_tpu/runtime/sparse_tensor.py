@@ -5,15 +5,19 @@ gradients for huge embedding tables, reduced rank-to-rank by exchanging
 (indices, values) instead of the dense table
 (``runtime/engine.py:1530-1586`` sparse_allreduce).
 
-TPU note (why the *engine* rejects ``sparse_gradients: true``, see
-``TPUEngine.__init__``): torch's sparse embedding autograd emits genuinely
-sparse gradients, so skipping dense allreduce saves real bandwidth there.
-XLA's AD always materializes dense gradients and its collectives are
-compiled over static dense shapes; a CSR re-compression inside the jitted
-step would add a gather/scatter round-trip without removing the dense
-buffer. The utility below is provided for API/tooling parity (checkpoint
-surgery, host-side gradient analysis) with the reference's semantics
-(sparse row dedup on ``to_dense``).
+TPU note: torch's sparse embedding autograd emits genuinely sparse
+gradients; XLA's AD always materializes dense cotangents, so the engine
+cannot re-compress them behind the user's back. The capability lives one
+level down instead: ``sparse_gradients: true`` makes the in-tree
+families' ``ops/embedding.embedding_lookup`` use a custom VJP whose
+cross-rank exchange all_gathers (ids, touched rows) over the data axes
+(``comm/sparse.py row_sparse_allreduce``) and scatter-adds locally —
+wire bytes ∝ batch tokens, and the dense [V, D] buffer never crosses the
+wire (tests/test_sparse_grads.py). A custom loss_fn still gets a loud
+ConfigError pointing at ``sparse_grad_axes``. The utility below is
+provided for API/tooling parity (checkpoint surgery, host-side gradient
+analysis) with the reference's semantics (sparse row dedup on
+``to_dense``).
 """
 
 from typing import Tuple

@@ -349,27 +349,36 @@ def build_streamed_loss(pipe_model, remat: bool = True, params: Any = None,
         x = pm.embed_fn(persistent, batch, r_embed)
         aux = pm.aux_fn(persistent, batch) if pm.aux_fn is not None else None
 
-        def inner(row_host, x, sub):
+        def inner(row_host, x, sub, idx):
             fetched = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, _TO_DEVICE), row_host)
             if use_tp:
                 blk = unpack_block_tp(fetched, meta, mesh)
             else:
                 blk = unpack_block(fetched, meta)
+            if pm.block_takes_layer_idx:
+                # per-layer schedules (PLD) need the block index — without
+                # it the gate runs at layer 0's keep-prob 1.0, silently
+                # inert (parallel/pipe/pipeline.py threads it the same way)
+                return pm.block_fn(blk, x, aux, sub, idx)
             return pm.block_fn(blk, x, aux, sub)
 
         if remat:
             inner = jax.checkpoint(inner)
 
-        def body(carry, row_host):
+        def body(carry, row_i):
+            row_host, idx = row_i
             x, r = carry
             if r is not None:
                 r, sub = jax.random.split(r)
             else:
                 sub = None
-            return (inner(row_host, x, sub), r), None
+            return (inner(row_host, x, sub, idx), r), None
 
-        (x, rng), _ = jax.lax.scan(body, (x, rng), host_params["blocks"])
+        n_blocks = jax.tree_util.tree_leaves(
+            host_params["blocks"])[0].shape[0]
+        (x, rng), _ = jax.lax.scan(
+            body, (x, rng), (host_params["blocks"], jnp.arange(n_blocks)))
         return pm.head_fn(persistent, x, batch)
 
     if use_tp:

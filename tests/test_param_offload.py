@@ -80,6 +80,26 @@ class TestStreamedLossParity:
             np.asarray(g_blk1["c_fc"]["kernel"]), rtol=1e-4,
             atol=1e-6)
 
+    def test_layer_idx_threads_through_scan(self, eight_devices):
+        """The streamed scan must hand block_fn the GLOBAL layer index
+        (per-layer schedules — PLD — are inert at idx=0: keep-prob 1.0).
+        With pld_theta=0 every layer l>0 has keep-prob 1-l/L, so the loss
+        must differ from the no-PLD run; if the index were stuck at 0 the
+        two would be bit-identical."""
+        model, cfg = make_gpt("tiny", **GPT_CFG, dtype=jnp.float32)
+        pm = gpt_pipe_model(cfg)
+        assert pm.block_takes_layer_idx
+        streamed, packed = po.build_streamed_loss(pm)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (2, 32), dtype=np.int32))}
+        key = jax.random.PRNGKey(7)
+        base = float(jax.jit(streamed)(packed, batch, key))
+        pld = float(jax.jit(streamed)(
+            packed, {**batch, "pld_theta": jnp.float32(0.0)}, key))
+        assert np.isfinite(pld)
+        assert abs(pld - base) > 1e-6, (base, pld)
+
     def test_dropout_rng_threads_per_layer(self, eight_devices):
         """With dropout on, the streamed loss must still run (per-layer rng
         split inside the scan) and give a finite loss."""

@@ -348,21 +348,81 @@ class TestPipelineComputeAccounting:
         assert count_dots(body) == 1, count_dots(body)
 
 
-class TestPipelinePLDGuard:
-    def test_pld_rejected(self, eight_devices):
-        """PLD's drop gates live in the flat families; the pipelined block
-        path never sees pld_theta — reject loudly instead of training
-        with layer drop silently inert."""
+class TestPipelinePLD:
+    """Progressive Layer Drop composes with the PipelineEngine (reference:
+    engine.forward threads PLD kwargs, /root/reference/deepspeed/runtime/
+    engine.py:1085, which pipe/engine.py:540 reaches via super().forward())
+    — the pipelined block path consumes pld_theta via aux and the global
+    layer index."""
+
+    def _engine(self, mesh, pld_cfg):
         cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=32,
                         num_layers=4, num_heads=2, dropout_rate=0.0,
                         dtype=jnp.float32)
         pm = gpt_pipe_model(cfg)
-        mesh = build_mesh(data=4, pipe=2)
+        extra = ({"progressive_layer_drop": pld_cfg} if pld_cfg else {})
         ds = DeepSpeedTPUConfig({
-            "train_micro_batch_size_per_gpu": 1,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1}, **extra})
+        return PipelineEngine(pm, ds, mesh=mesh)
+
+    def _batches(self):
+        rng = np.random.default_rng(0)
+        return {"input_ids": rng.integers(0, 128, (4, 4, 32),
+                                          dtype=np.int32)}
+
+    def test_pp2_trains_and_theta_decays(self, eight_devices):
+        mesh = build_mesh(data=4, pipe=2)
+        eng = self._engine(mesh, {"enabled": True, "theta": 0.5,
+                                  "gamma": 0.01})
+        losses = [float(eng.train_batch(self._batches())) for _ in range(6)]
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
+        assert eng.progressive_layer_drop.current_theta < 1.0
+
+    def test_theta_one_matches_pld_off(self, eight_devices):
+        """theta=1, gamma=0 keeps every layer (p_keep = 1 for all l) —
+        the pipelined loss must equal the PLD-off pipeline bit-for-bit,
+        pinning the gate's theta schedule against the flat family's."""
+        mesh = build_mesh(data=4, pipe=2)
+        batches = self._batches()
+        l_off = float(self._engine(mesh, None).train_batch(batches))
+        l_one = float(self._engine(
+            mesh, {"enabled": True, "theta": 1.0,
+                   "gamma": 0.0}).train_batch(batches))
+        assert l_one == pytest.approx(l_off, rel=1e-6)
+
+    def test_low_theta_differs(self, eight_devices):
+        """theta(0) is always 1.0 (the schedule decays from keep-all), so
+        step 1 matches PLD-off; with gamma=5 theta(1)~=theta_bar=0.05 and
+        step 2's gates actually drop layers — its loss must diverge."""
+        mesh = build_mesh(data=4, pipe=2)
+        batches = self._batches()
+        e_off = self._engine(mesh, None)
+        e_low = self._engine(mesh, {"enabled": True, "theta": 0.05,
+                                    "gamma": 5.0})
+        l_off1, l_off2 = (float(e_off.train_batch(batches))
+                          for _ in range(2))
+        l_low1, l_low2 = (float(e_low.train_batch(batches))
+                          for _ in range(2))
+        assert l_low1 == pytest.approx(l_off1, rel=1e-6)   # theta(0) = 1
+        assert np.isfinite(l_low2)
+        assert abs(l_low2 - l_off2) > 1e-6
+
+    def test_custom_model_without_layer_idx_rejected(self, eight_devices):
+        from dataclasses import replace
+
+        cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=32,
+                        num_layers=4, num_heads=2, dropout_rate=0.0,
+                        dtype=jnp.float32)
+        pm = replace(gpt_pipe_model(cfg), block_takes_layer_idx=False)
+        ds = DeepSpeedTPUConfig({
+            "train_micro_batch_size_per_gpu": 2,
             "gradient_accumulation_steps": 4,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
             "zero_optimization": {"stage": 1},
             "progressive_layer_drop": {"enabled": True}})
-        with pytest.raises(ValueError, match="progressive_layer_drop"):
-            PipelineEngine(pm, ds, mesh=mesh)
+        with pytest.raises(ValueError, match="block_takes_layer_idx"):
+            PipelineEngine(pm, ds, mesh=build_mesh(data=4, pipe=2))

@@ -1,0 +1,74 @@
+"""Round-5 fused LN+projection A/B on the real chip, bench config, one
+process (the tunnel drifts ±10-12% between runs — only in-run comparisons
+count). Variants: unfused baseline, fused_ln at both pre-LN sites,
+each with dropout off and on (0.1)."""
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+PEAK = 197.0
+
+
+def run_variant(name, steps=8, windows=2, dropout_rate=0.0, **overrides):
+    import deepspeed_tpu
+    from deepspeed_tpu.models import make_gpt
+
+    model, cfg = make_gpt("gpt2", dropout_rate=dropout_rate, remat=False,
+                          max_seq_len=512, **overrides)
+    rng = np.random.default_rng(0)
+    micro_bs, seq, gas = 16, 512, 8
+    batches = {"input_ids": rng.integers(0, cfg.vocab_size,
+                                         (gas, micro_bs, seq),
+                                         dtype=np.int32)}
+    one = jax.tree_util.tree_map(lambda x: x[0], batches)
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)}, one)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, params=params,
+        config={
+            "train_micro_batch_size_per_gpu": micro_bs,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 2},
+            "data_types": {"grad_accum_dtype": "bfloat16"},
+            "bf16": {"enabled": True},
+        })
+    for _ in range(2):
+        loss = engine.train_batch(batches)
+    _ = float(loss)
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batches)
+        _ = float(loss)   # scalar fetch = tunnel fence
+        best = min(best, time.perf_counter() - t0)
+    tokens = gas * micro_bs * seq * steps
+    tps = tokens / best
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(params))
+    flops = (6.0 * n_params + 12 * 12 * 768 * 512) * tokens
+    mfu = flops / best / 1e12 / PEAK
+    print(f"[{name}] {tps:,.0f} tok/s  MFU {mfu:.1%}  "
+          f"(loss {float(loss):.3f})", flush=True)
+    del engine
+    return tps
+
+
+def main():
+    print("platform:", jax.devices()[0].platform, flush=True)
+    base = run_variant("base     off", fused_ln=False)
+    qkv = run_variant("qkv-only off", fused_ln="qkv")
+    mlp = run_variant("mlp-only off", fused_ln="mlp")
+    fused = run_variant("fused    off", fused_ln=True)
+    print(f"qkv/base: {qkv / base:.3f}  mlp/base: {mlp / base:.3f}  "
+          f"both/base: {fused / base:.3f}")
+
+
+if __name__ == "__main__":
+    main()
