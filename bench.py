@@ -212,6 +212,33 @@ def bench_gpt2_long(steps, warmup, sparse: bool, seq=16384):
     return tokens / dt
 
 
+def bench_inference(batch, new_tokens=128, prompt=128, windows=3):
+    """Generation throughput (tokens/s) through the inference engine's
+    jitted prefill+decode: the reference stakes latency claims on its
+    inference kernels (docs/_tutorials/inference-tutorial.md); this is the
+    capability-parity evidence row (KV cache, one dispatch per call)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import make_gpt
+
+    model, cfg = make_gpt("gpt2", dropout_rate=0.0,
+                          max_seq_len=prompt + new_tokens)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, prompt), dtype=np.int32)
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)},
+                        {"input_ids": ids[:1]})["params"]
+    eng = deepspeed_tpu.init_inference(model, params=params)
+    out = eng.generate(ids, max_new_tokens=new_tokens)   # compile
+    _ = np.asarray(out[0, -1])
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        out = eng.generate(ids, max_new_tokens=new_tokens)
+        _ = np.asarray(out[0, -1])   # fence
+        best = min(best, time.perf_counter() - t0)
+    return batch * new_tokens / best
+
+
 def _flush_partial(result):
     try:
         tmp = PARTIAL_PATH + ".tmp"
@@ -360,10 +387,22 @@ def main():
         result["gpt2_seq16k_sparse_speedup"] = round(
             long_sparse / long_dense, 3)
 
+    def sec_inference():
+        t0 = time.time()
+        tps1 = bench_inference(batch=1)
+        result["gpt2_generate_b1_tokens_per_sec"] = round(tps1, 1)
+        _flush_partial(result)
+        tps8 = bench_inference(batch=8)
+        log(f"[bench] GPT-2 generate (KV cache, prompt 128 + 128 new): "
+            f"b1 {tps1:.1f} tok/s, b8 {tps8:.1f} tok/s "
+            f"({time.time() - t0:.0f}s)")
+        result["gpt2_generate_b8_tokens_per_sec"] = round(tps8, 1)
+
     sections = [("bert128", sec_bert128)]
     if on_tpu:
         sections += [("bert512", sec_bert512), ("gpt2", sec_gpt2),
-                     ("gpt2_dropout", sec_gpt2_dropout), ("long16k", sec_long)]
+                     ("gpt2_dropout", sec_gpt2_dropout), ("long16k", sec_long),
+                     ("inference", sec_inference)]
     n_ok = 0
     for name, fn in sections:
         n_ok += bool(run_section(name, fn, result))

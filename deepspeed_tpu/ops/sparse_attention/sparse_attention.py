@@ -476,13 +476,26 @@ def sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(f"layout has {np.asarray(layout).shape[1]} blocks, "
                          f"sequence needs {s // block}")
     scale = softmax_scale if softmax_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # Mosaic lane-alignment constraint: the masked kernels slice the
+    # [B, 1, S] mask on its LANE dim at the dynamic per-row column offset
+    # (col*block), which TPU lowering only admits when it is provably a
+    # multiple of 128 — i.e. block % 128 == 0 (the long-sequence configs;
+    # the K/V slices are sublane-dim and only need block % 8). Interpret
+    # mode (CPU) has no such constraint.
+    masked_pallas_ok = key_mask is None or block % 128 == 0
     if impl == "auto":
-        impl = "pallas" if jax.devices()[0].platform == "tpu" else "xla"
+        impl = ("pallas" if on_tpu and masked_pallas_ok else "xla")
     if impl == "xla":
         return _xla_sparse(q, k, v, layout, block, causal, scale, key_mask)
     if impl == "pallas":
         if interpret is None:
-            interpret = jax.devices()[0].platform != "tpu"
+            interpret = not on_tpu
+        if not interpret and not masked_pallas_ok:
+            raise ValueError(
+                f"key_mask with block={block} cannot lower to Mosaic "
+                "(mask lane-slices need block % 128 == 0 on TPU) — use "
+                "block >= 128, impl='xla', or drop the mask")
         return _pallas_sparse(q, k, v, layout, block, causal, scale,
                               interpret, key_mask=key_mask)
     raise ValueError(f"unknown sparse attention impl '{impl}'")

@@ -96,10 +96,20 @@ class PipelineEngine(TPUEngine):
             h = pipeline_apply(pm.block_fn, compute_params["blocks"], embeds,
                                mesh, aux=aux, rng=rng, num_microbatches=gas,
                                remat_blocks=True,
-                               pass_layer_idx=pm.block_takes_layer_idx)
+                               pass_layer_idx=pm.block_takes_layer_idx,
+                               block_aux=pm.block_returns_aux)
+            aux_total = None
+            if pm.block_returns_aux:
+                h, aux_total = h
             losses = jax.vmap(
                 lambda hm, bm: pm.head_fn(compute_params, hm, bm))(h, batches)
-            return jnp.mean(losses.astype(jnp.float32))
+            loss = jnp.mean(losses.astype(jnp.float32))
+            if aux_total is not None:
+                # aux_total sums every (microbatch, layer) contribution
+                # (alpha folded in by block_fn); /gas gives the
+                # per-microbatch mean matching the flat family's loss.
+                loss = loss + aux_total / gas
+            return loss
 
         return pipe_loss
 
@@ -167,7 +177,11 @@ class PipelineEngine(TPUEngine):
                     pm.block_fn, cp["blocks"], embeds, aux, sub,
                     stages=stages, num_microbatches=gas, remat_blocks=True,
                     broadcast_output=False,
-                    pass_layer_idx=pm.block_takes_layer_idx)
+                    pass_layer_idx=pm.block_takes_layer_idx,
+                    block_aux=pm.block_returns_aux)
+                aux_total = None
+                if pm.block_returns_aux:
+                    h, aux_total = h
                 if stages > 1:
                     last = jax.lax.axis_index(PIPE_AXIS) == stages - 1
                     # Zero invalid-rank activations BEFORE the head so the
@@ -181,6 +195,9 @@ class PipelineEngine(TPUEngine):
                 if stages > 1:
                     loss = jax.lax.psum(jnp.where(last, loss, 0.0),
                                         PIPE_AXIS)
+                if aux_total is not None:
+                    # already psum'd over pipe inside the pipeline body
+                    loss = loss + aux_total / gas
                 return loss * scale, loss
 
             (_, loss), grads = jax.value_and_grad(

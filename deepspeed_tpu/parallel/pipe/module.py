@@ -71,6 +71,10 @@ class PipeModel:
     # block_fn takes a 5th arg: the GLOBAL layer index (stage offset +
     # local position) — needed by per-layer schedules (PLD).
     block_takes_layer_idx: bool = False
+    # block_fn returns (h, aux_scalar): the pipeline masks bubble ticks,
+    # psums the aux over pipe, and the engine adds mean-per-microbatch
+    # aux to the loss (MoE load-balance losses).
+    block_returns_aux: bool = False
 
     def check(self, pipe_size: int) -> None:
         if self.num_blocks % pipe_size:
@@ -106,7 +110,13 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
                                example_batch)
         flat = variables["params"]
 
-    block = GPTBlock(cfg)
+    moe = getattr(cfg, "moe_experts", 0) > 0
+    if moe and cfg.moe_layer_freq != 1:
+        raise ValueError(
+            "MoE x pipeline needs structurally identical blocks "
+            "(the stacked-block contract): use moe_layer_freq=1 so every "
+            f"block carries the MoE FFN (got {cfg.moe_layer_freq})")
+    block = GPTBlock(cfg, moe=moe)
     from deepspeed_tpu.parallel.pipe.pipeline import stack_blocks
 
     blocks = stack_blocks([flat[f"h_{i}"] for i in range(cfg.num_layers)])
@@ -159,10 +169,16 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
     def block_fn(p, x, aux, rng, layer_idx=0):
         mask, theta = _unpack_aux(aux)
         if rng is None or cfg.dropout_rate == 0.0:
+            # MoE routing needs a (deterministic-OK) rng collection only
+            # when dropout is active; the top-k router itself is
+            # deterministic.
             y = block.apply({"params": p}, x, mask, True)
         else:
             y = block.apply({"params": p}, x, mask, False,
                             rngs={"dropout": rng})
+        aux_l = None
+        if moe:
+            y, aux_l = y
         if theta is not None and rng is not None:
             # The SAME keep schedule as the flat families — one shared
             # implementation so the pipelined trajectory cannot drift.
@@ -171,6 +187,15 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
             gate = pld_keep_gate(jax.random.fold_in(rng, 0x9E37),
                                  layer_idx, cfg.num_layers, theta)
             y = jnp.where(gate, y, x)
+            if aux_l is not None:
+                # a dropped MoE layer contributed nothing — its balance
+                # loss must not push its router (same rule as the flat
+                # family, models/gpt.py)
+                aux_l = jnp.where(gate, aux_l, 0.0)
+        if moe:
+            # alpha folded in here so the engine can just ADD the psum'd
+            # scalar: loss = mean_m(ce_m) + sum(aux)/M.
+            return y, cfg.moe_aux_alpha * aux_l
         return y
 
     # Final LN through flax's own LayerNorm (same impl/epsilon as the
@@ -208,4 +233,5 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
 
     return PipeModel(embed_fn=embed_fn, block_fn=block_fn,
                      head_fn=head_fn, aux_fn=aux_fn, params=params,
-                     num_blocks=cfg.num_layers, block_takes_layer_idx=True)
+                     num_blocks=cfg.num_layers, block_takes_layer_idx=True,
+                     block_returns_aux=moe)

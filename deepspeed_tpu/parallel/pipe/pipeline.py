@@ -49,6 +49,27 @@ def pipeline_spec(blocks_params) -> Any:
         lambda x: P(PIPE_AXIS, *([None] * (x.ndim - 1))), blocks_params)
 
 
+def default_skip_bubble() -> bool:
+    """Whether fill/drain ticks skip their compute (``lax.cond`` on the
+    per-rank validity predicate — the reference's 1F1B executes no bubble
+    instructions by construction, pipe/schedule.py:182; here the cond
+    saves the (S−1)/(M+S−1) bubble energy). Resolved at trace time:
+    ``DSTPU_SKIP_BUBBLE`` = ``1``/``0`` forces it; default = TPU only.
+    On XLA:CPU the cond composes with ZeRO-1's data-axis apply
+    collectives into a deterministic second-step rendezvous DEADLOCK
+    (pinned round 5 — ``tools/repro_cond_ppermute_deadlock.py``; ZeRO-0
+    + cond runs fine and is CI-exercised, docs/ISSUES.md #1)."""
+    import os
+
+    v = os.environ.get("DSTPU_SKIP_BUBBLE", "")
+    if v in ("0", "1"):
+        return v == "1"
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover — no backend
+        return False
+
+
 # Cache of jitted pipelined programs: rebuilding shard_map+jit per call would
 # recompile on every eager invocation. Keyed by everything that changes the
 # traced program except array shapes (jit handles shape retracing itself).
@@ -65,7 +86,9 @@ def pipeline_apply_manual(block_fn: Callable,
                           num_microbatches: int,
                           remat_blocks: bool = True,
                           broadcast_output: bool = True,
-                          pass_layer_idx: bool = False) -> jax.Array:
+                          pass_layer_idx: bool = False,
+                          block_aux: bool = False,
+                          skip_bubble: Optional[bool] = None):
     """The manual-region pipeline body: call INSIDE a shard_map already
     manual over ``pipe`` (``stage_blocks`` leaves carry the local
     ``[L/S, ...]`` shard; ``x_all`` ``[M, mb, ...]`` is pipe-replicated).
@@ -84,23 +107,38 @@ def pipeline_apply_manual(block_fn: Callable,
     per-layer schedules like Progressive Layer Drop need (the flat
     families read it from the Python loop counter; the reference threads
     PLD kwargs through engine.forward into each layer,
-    /root/reference/deepspeed/runtime/engine.py:1085)."""
+    /root/reference/deepspeed/runtime/engine.py:1085).
+
+    ``block_aux``: block_fn returns ``(h, aux_scalar)`` (e.g. a MoE
+    load-balance loss). The return value grows a second element: the
+    fp32 aux total summed over every (microbatch, layer) — bubble ticks
+    masked out, psum'd over ``pipe`` — which the caller folds into the
+    loss (divide by M for the per-microbatch mean). Reference analogue:
+    DeepSpeed-MoE's aux losses ride the module outputs through the
+    pipeline the same way."""
     M = num_microbatches
+    if skip_bubble is None:
+        skip_bubble = default_skip_bubble()
     fn = jax.checkpoint(block_fn) if remat_blocks else block_fn
     n_local = jax.tree_util.tree_leaves(stage_blocks)[0].shape[0]
 
     def stage_apply(h, a, key, base):
         # Apply this stage's L/S blocks in order (scan keeps the program
         # small; blocks are structurally identical by contract).
-        def body(h, xs):
+        def body(carry, xs):
+            h, aux = carry
             p, i = xs
             k = None if key is None else jax.random.fold_in(key, i)
-            if pass_layer_idx:
-                return fn(p, h, a, k, base + i), None
-            return fn(p, h, a, k), None
+            args = (p, h, a, k) + ((base + i,) if pass_layer_idx else ())
+            y = fn(*args)
+            if block_aux:
+                y, a_l = y
+                aux = aux + a_l.astype(jnp.float32)
+            return (y, aux), None
 
-        h, _ = jax.lax.scan(body, h, (stage_blocks, jnp.arange(n_local)))
-        return h
+        (h, aux), _ = jax.lax.scan(body, (h, jnp.float32(0.0)),
+                                   (stage_blocks, jnp.arange(n_local)))
+        return h, aux
 
     def aux_at(idx):
         if aux_all is None:
@@ -115,54 +153,69 @@ def pipeline_apply_manual(block_fn: Callable,
             return stage_apply(mb, aux_at(i), key, 0)
 
         if aux_all is None:
-            return jax.vmap(per_mb)(x_all, jnp.arange(M))
-        # aux indexing is data-dependent per microbatch — use scan
-        def body(_, mi):
-            mb, i = mi
-            return None, per_mb(mb, i)
+            out, auxs = jax.vmap(per_mb)(x_all, jnp.arange(M))
+        else:
+            # aux indexing is data-dependent per microbatch — use scan
+            def body(_, mi):
+                mb, i = mi
+                return None, per_mb(mb, i)
 
-        _, out = jax.lax.scan(body, None, (x_all, jnp.arange(M)))
-        return out
+            _, (out, auxs) = jax.lax.scan(body, None, (x_all, jnp.arange(M)))
+        return (out, jnp.sum(auxs)) if block_aux else out
 
     T = M + stages - 1
     rank = jax.lax.axis_index(PIPE_AXIS)
     shift = [(i, (i + 1) % stages) for i in range(stages)]
 
     def tick(carry, t):
-        buf = carry
+        buf, aux_acc = carry
         inject = jax.lax.dynamic_index_in_dim(
             x_all, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
         h = jnp.where(rank == 0, inject, buf)
         # Stage `rank` processes microbatch m = t - rank at tick t;
         # fill/drain ticks (m outside [0, M)) carry garbage that no
         # valid tick ever consumes (producer (r-1, t-1) has the same m
-        # as consumer (r, t)). Executing stage_apply on those ticks
-        # does NOT cost wall-clock — the ppermute keeps ranks in
-        # lockstep and some rank is always active, so the step time is
-        # the critical-path bound T·stage_time either way (proven by
+        # as consumer (r, t)). Wall-clock is the critical-path bound
+        # T·stage_time either way (the ppermute keeps ranks in lockstep;
         # tests/test_pipeline.py::test_step_time_approaches_bubble_
-        # bound); it costs only energy on the (S-1)/(M+S-1) bubble
-        # fraction. A `lax.cond` on the validity predicate would skip
-        # that too and is semantically safe here (garbage flows only
-        # into garbage), and it transposes/remats correctly in minimal
-        # repros — but the full model aborts XLA:CPU at runtime under
-        # this partial-manual shard_map (same backend fragility as the
-        # bf16-psum note below), and with one real TPU chip a
-        # TPU-only branch would ship unexercised. Revisit when the
-        # backend bug is gone (tracked: docs/ISSUES.md #1).
+        # bound), so skip_bubble saves the (S-1)/(M+S-1) bubble ENERGY:
+        # default on for TPU, off for XLA:CPU where the cond composes
+        # with ZeRO-1 apply collectives into a second-step rendezvous
+        # deadlock (pinned: tools/repro_cond_ppermute_deadlock.py,
+        # docs/ISSUES.md #1; the ZeRO-0 cond path is CI-exercised by
+        # TestBubbleSkip).
         m = t - rank
         a = aux_at(jnp.clip(m, 0, M - 1))
         k = (None if keys is None
              else jax.random.fold_in(jax.random.fold_in(keys, t), rank))
-        y = stage_apply(h, a, k, rank * n_local)
+        valid = jnp.logical_and(m >= 0, m < M)
+        if skip_bubble:
+            # Fill/drain ticks carry garbage no valid tick consumes —
+            # skip their compute entirely (the reference's 1F1B executes
+            # no bubble instructions by construction, pipe/schedule.py).
+            # Per-rank divergence is fine under the manual shard_map: the
+            # ppermute below still runs on every rank in lockstep.
+            y, aux_y = jax.lax.cond(
+                valid,
+                lambda: stage_apply(h, a, k, rank * n_local),
+                lambda: (h, jnp.float32(0.0)))
+        else:
+            y, aux_y = stage_apply(h, a, k, rank * n_local)
+        # Bubble ticks' aux contribution must not pollute the loss.
+        aux_acc = aux_acc + jnp.where(valid, aux_y, 0.0)
         buf = jax.lax.ppermute(y, PIPE_AXIS, shift)
-        return buf, y
+        return (buf, aux_acc), y
 
-    _, ys = jax.lax.scan(tick, jnp.zeros_like(x_all[0]), jnp.arange(T))
+    (_, aux_total), ys = jax.lax.scan(
+        tick, (jnp.zeros_like(x_all[0]), jnp.float32(0.0)), jnp.arange(T))
     # Last stage produced microbatch m at tick m + S - 1.
     out = jax.lax.dynamic_slice_in_dim(ys, stages - 1, M, axis=0)
+    if block_aux:
+        # Each rank accumulated its own blocks' aux; the psum yields the
+        # total over every (microbatch, layer), identical on all ranks.
+        aux_total = jax.lax.psum(aux_total, PIPE_AXIS)
     if not broadcast_output:
-        return out
+        return (out, aux_total) if block_aux else out
     # Hand the result to every pipe rank (the reference broadcasts the
     # final-stage loss similarly, pipe/engine.py:453); activations of
     # non-final stages are discarded by the where. The psum runs in fp32:
@@ -171,7 +224,8 @@ def pipeline_apply_manual(block_fn: Callable,
     # summation is the numerically safer choice anyway.
     masked = jnp.where(rank == stages - 1, out,
                        jnp.zeros_like(out)).astype(jnp.float32)
-    return jax.lax.psum(masked, PIPE_AXIS).astype(out.dtype)
+    out = jax.lax.psum(masked, PIPE_AXIS).astype(out.dtype)
+    return (out, aux_total) if block_aux else out
 
 
 def pipeline_apply(block_fn: Callable,
@@ -183,7 +237,9 @@ def pipeline_apply(block_fn: Callable,
                    rng: Optional[jax.Array] = None,
                    num_microbatches: Optional[int] = None,
                    remat_blocks: bool = True,
-                   pass_layer_idx: bool = False) -> jax.Array:
+                   pass_layer_idx: bool = False,
+                   block_aux: bool = False,
+                   skip_bubble: Optional[bool] = None):
     """Run the stacked-block pipeline over microbatches.
 
     block_fn(params_one_block, x, aux_or_None, rng_or_None) -> x
@@ -207,11 +263,15 @@ def pipeline_apply(block_fn: Callable,
     if x.shape[0] != M:
         raise ValueError(f"x has {x.shape[0]} microbatches, expected {M}")
 
+    if skip_bubble is None:
+        skip_bubble = default_skip_bubble()
     if stages == 1:
         return pipeline_apply_manual(block_fn, blocks_params, x, aux, rng,
                                      stages=1, num_microbatches=M,
                                      remat_blocks=remat_blocks,
-                                     pass_layer_idx=pass_layer_idx)
+                                     pass_layer_idx=pass_layer_idx,
+                                     block_aux=block_aux,
+                                     skip_bubble=skip_bubble)
 
     compute_dtype = x.dtype
 
@@ -226,7 +286,8 @@ def pipeline_apply(block_fn: Callable,
             block_fn, stage_blocks, x_all.astype(compute_dtype), aux_all,
             keys, stages=stages, num_microbatches=M,
             remat_blocks=remat_blocks, broadcast_output=True,
-            pass_layer_idx=pass_layer_idx)
+            pass_layer_idx=pass_layer_idx, block_aux=block_aux,
+            skip_bubble=skip_bubble)
 
     blocks_treedef = jax.tree_util.tree_structure(blocks_params)
     blocks_ndims = tuple(l.ndim for l in jax.tree_util.tree_leaves(blocks_params))
@@ -234,14 +295,14 @@ def pipeline_apply(block_fn: Callable,
                    else jax.tree_util.tree_structure(aux))
     key = (block_fn, mesh, stages, M, remat_blocks, rng is None,
            blocks_treedef, blocks_ndims, aux_treedef, compute_dtype,
-           pass_layer_idx)
+           pass_layer_idx, block_aux, skip_bubble)
     if key not in _PIPELINE_CACHE:
         def entry(blocks_arg, x_arg, aux_arg, rng_arg):
             return shard_map(
                 pipelined,
                 mesh=mesh,
                 in_specs=(pipeline_spec(blocks_arg), P(), P(), P()),
-                out_specs=P(),
+                out_specs=(P(), P()) if block_aux else P(),
                 axis_names={PIPE_AXIS},
                 check_vma=False,
             )(blocks_arg, x_arg, aux_arg, rng_arg)

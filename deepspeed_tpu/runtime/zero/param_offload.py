@@ -360,26 +360,34 @@ def build_streamed_loss(pipe_model, remat: bool = True, params: Any = None,
                 # per-layer schedules (PLD) need the block index — without
                 # it the gate runs at layer 0's keep-prob 1.0, silently
                 # inert (parallel/pipe/pipeline.py threads it the same way)
-                return pm.block_fn(blk, x, aux, sub, idx)
-            return pm.block_fn(blk, x, aux, sub)
+                y = pm.block_fn(blk, x, aux, sub, idx)
+            else:
+                y = pm.block_fn(blk, x, aux, sub)
+            if not pm.block_returns_aux:
+                y = (y, jnp.float32(0.0))
+            return y
 
         if remat:
             inner = jax.checkpoint(inner)
 
         def body(carry, row_i):
             row_host, idx = row_i
-            x, r = carry
+            x, r, aux_acc = carry
             if r is not None:
                 r, sub = jax.random.split(r)
             else:
                 sub = None
-            return (inner(row_host, x, sub, idx), r), None
+            y, a_l = inner(row_host, x, sub, idx)
+            return (y, r, aux_acc + a_l.astype(jnp.float32)), None
 
         n_blocks = jax.tree_util.tree_leaves(
             host_params["blocks"])[0].shape[0]
-        (x, rng), _ = jax.lax.scan(
-            body, (x, rng), (host_params["blocks"], jnp.arange(n_blocks)))
-        return pm.head_fn(persistent, x, batch)
+        (x, rng, aux_acc), _ = jax.lax.scan(
+            body, (x, rng, jnp.float32(0.0)),
+            (host_params["blocks"], jnp.arange(n_blocks)))
+        loss = pm.head_fn(persistent, x, batch)
+        # MoE blocks' (alpha-scaled) balance losses; zero otherwise.
+        return loss + aux_acc
 
     if use_tp:
         tp_entry = (meta["tp_axes"][0] if len(meta["tp_axes"]) == 1

@@ -348,6 +348,121 @@ class TestPipelineComputeAccounting:
         assert count_dots(body) == 1, count_dots(body)
 
 
+class TestBubbleSkip:
+    """The 1F1B bubble skip (lax.cond on the per-rank validity predicate
+    — reference pipe/schedule.py:182 executes no bubble instructions).
+    Default-on for TPU; exercised here on CPU with ZeRO-0 (the ZeRO-1 ×
+    cond × XLA:CPU second-step rendezvous deadlock is pinned in
+    tools/repro_cond_ppermute_deadlock.py, docs/ISSUES.md #1)."""
+
+    def _engine(self, monkeypatch, skip, stage=0):
+        import deepspeed_tpu.parallel.pipe.pipeline as pl
+
+        monkeypatch.setattr(pl, "default_skip_bubble", lambda: skip)
+        cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=32,
+                        num_layers=4, num_heads=2, dropout_rate=0.0,
+                        dtype=jnp.float32)
+        return PipelineEngine(gpt_pipe_model(cfg), DeepSpeedTPUConfig({
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": stage}}),
+            mesh=build_mesh(data=4, pipe=2))
+
+    def test_cond_matches_always_execute(self, eight_devices, monkeypatch):
+        """Skipping bubble compute must be numerically transparent: the
+        garbage ticks never fed a valid output anyway."""
+        rng = np.random.default_rng(0)
+        b = {"input_ids": rng.integers(0, 128, (4, 4, 32), dtype=np.int32)}
+        e_skip = self._engine(monkeypatch, True)
+        l_skip = [float(e_skip.train_batch(b)) for _ in range(3)]
+        e_run = self._engine(monkeypatch, False)
+        l_run = [float(e_run.train_batch(b)) for _ in range(3)]
+        np.testing.assert_allclose(l_skip, l_run, rtol=1e-6)
+
+    def test_cond_present_in_jaxpr(self, eight_devices, monkeypatch):
+        """Structural evidence for the TPU default (un-runnable multi-chip
+        here): with skip on, the tick body's stage compute sits under a
+        cond — bubble ticks execute no dots."""
+        from deepspeed_tpu.parallel.pipe.pipeline import (
+            pipeline_apply_manual)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = build_mesh(data=4, pipe=2)
+        blocks = {"w": jnp.zeros((4, 16, 16), jnp.float32)}
+
+        def block_fn(p, x, a, k):
+            return jnp.tanh(x @ p["w"])
+
+        def run(blocks, x):
+            return shard_map(
+                lambda bl, xx: pipeline_apply_manual(
+                    block_fn, bl, xx, None, None, stages=2,
+                    num_microbatches=4, remat_blocks=False,
+                    skip_bubble=True),
+                mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P(),
+                axis_names={"pipe"}, check_vma=False)(blocks, x)
+
+        jaxpr = jax.make_jaxpr(run)(blocks,
+                                    jnp.zeros((4, 8, 16), jnp.float32))
+        text = str(jaxpr)
+        assert "cond[" in text
+        # the dot lives inside a cond branch, not the raw tick body
+        tick_scan = text.split("cond[")[0]
+        assert "dot_general" not in tick_scan.split("scan[")[-1]
+
+
+class TestPipelineMoE:
+    """MoE FFN blocks through the pipeline (moe_layer_freq=1 keeps the
+    stacked-block contract): the load-balance aux rides the scan, bubble
+    ticks masked, psum'd over pipe — trajectory must match the flat MoE
+    family."""
+
+    CFG = dict(vocab_size=128, max_seq_len=32, hidden_size=32, num_layers=4,
+               num_heads=2, dropout_rate=0.0, dtype=jnp.float32,
+               moe_experts=2, moe_k=1, moe_layer_freq=1)
+
+    def _batches(self):
+        rng = np.random.default_rng(0)
+        return {"input_ids": rng.integers(0, 128, (4, 8, 32),
+                                          dtype=np.int32)}
+
+    def test_pp2_matches_flat_moe(self, eight_devices):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import make_gpt
+
+        batches = self._batches()
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1}}
+
+        cfg = GPTConfig(**self.CFG)
+        pm = gpt_pipe_model(cfg)
+        pipe = PipelineEngine(pm, DeepSpeedTPUConfig(config),
+                              mesh=build_mesh(data=4, pipe=2))
+        l_pipe = [float(pipe.train_batch(batches)) for _ in range(3)]
+
+        model, _ = make_gpt(cfg)
+        params = model.init(
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(0)},
+            {"input_ids": batches["input_ids"][0]})["params"]
+        flat, _, _, _ = deepspeed_tpu.initialize(
+            model=model, params=params, mesh=build_mesh(data=8),
+            config={**config, "train_micro_batch_size_per_gpu": 1})
+        l_flat = [float(flat.train_batch(batches)) for _ in range(3)]
+        np.testing.assert_allclose(l_pipe, l_flat, rtol=2e-4,
+                                   err_msg="MoE pipeline vs flat")
+
+    def test_heterogeneous_moe_rejected(self):
+        cfg = GPTConfig(**{**self.CFG, "moe_layer_freq": 2})
+        with pytest.raises(ValueError, match="moe_layer_freq"):
+            gpt_pipe_model(cfg)
+
+
 class TestPipelinePLD:
     """Progressive Layer Drop composes with the PipelineEngine (reference:
     engine.forward threads PLD kwargs, /root/reference/deepspeed/runtime/

@@ -349,6 +349,16 @@ class TestConfigDrivenSparse:
                                       np.asarray(new["wpe"][:64]))
 
 
+def _mask_blk_seq():
+    """Masked-pallas shapes per platform: Mosaic lane-slices the mask at
+    col*block, admitted only for block % 128 == 0 — so the on-chip run
+    uses the long-seq geometry (blk 128) while CPU-interpret keeps the
+    small fast shapes."""
+    if jax.devices()[0].platform == "tpu":
+        return 128, 512
+    return 16, 128
+
+
 class TestPallasKeyMask:
     """Key-padding mask inside the Pallas sparse kernels (r4 review
     finding: auto used to silently fall back to the dense-materializing
@@ -360,38 +370,60 @@ class TestPallasKeyMask:
             BigBirdSparsityConfig, sparse_attention)
 
         rng = np.random.default_rng(0)
-        b, s, h, d, blk = 2, 128, 4, 64, 16
+        blk, s = _mask_blk_seq()
+        b, h, d = 2, 4, 64
         sc = BigBirdSparsityConfig(num_heads=h, block=blk,
                                    num_random_blocks=1,
                                    num_sliding_window_blocks=3,
                                    num_global_blocks=1)
         layout = sc.make_layout(s)
+        keep = s - 28
         q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32) * .1
         k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32) * .1
         v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32) * .1
         mask = np.ones((b, s), np.int32)
-        mask[:, 100:] = 0
+        mask[:, keep:] = 0
         mask = jnp.asarray(mask)
         ref = sparse_attention(q, k, v, layout, blk, causal=causal,
                                key_mask=mask, impl="xla")
         out = sparse_attention(q, k, v, layout, blk, causal=causal,
                                key_mask=mask, impl="pallas")
-        np.testing.assert_allclose(np.asarray(out)[:, :100],
-                                   np.asarray(ref)[:, :100],
+        np.testing.assert_allclose(np.asarray(out)[:, :keep],
+                                   np.asarray(ref)[:, :keep],
                                    atol=2e-5, rtol=2e-5)
+
+    def test_masked_small_block_rejected_on_mosaic(self):
+        """block < 128 + key_mask cannot lane-slice on TPU — explicit
+        pallas must raise BEFORE lowering (auto dispatches to xla)."""
+        from deepspeed_tpu.ops.sparse_attention import (
+            BigBirdSparsityConfig, sparse_attention)
+
+        b, s, h, d, blk = 1, 128, 2, 64, 16
+        sc = BigBirdSparsityConfig(num_heads=h, block=blk,
+                                   num_random_blocks=1,
+                                   num_sliding_window_blocks=3,
+                                   num_global_blocks=1)
+        layout = sc.make_layout(s)
+        q = jnp.zeros((b, s, h, d), jnp.float32)
+        mask = jnp.ones((b, s), jnp.int32)
+        with pytest.raises(ValueError, match="block % 128"):
+            sparse_attention(q, q, q, layout, blk, key_mask=mask,
+                             impl="pallas", interpret=False)
 
     def test_masked_grads_match_xla(self):
         from deepspeed_tpu.ops.sparse_attention import (
             BSLongformerSparsityConfig, sparse_attention)
 
         rng = np.random.default_rng(1)
-        b, s, h, d, blk = 1, 64, 2, 64, 16
+        blk, s = _mask_blk_seq()
+        s = s // 2 if blk < 128 else s      # keep the CPU case tiny
+        b, h, d = 1, 2, 64
         sc = BSLongformerSparsityConfig(num_heads=h, block=blk,
                                         num_sliding_window_blocks=3)
         layout = sc.make_layout(s)
         q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32) * .1
         mask = np.ones((b, s), np.int32)
-        mask[:, 48:] = 0
+        mask[:, s - 16:] = 0
         mask = jnp.asarray(mask)
         w = jnp.asarray(np.asarray(mask), jnp.float32)[:, :, None, None]
 

@@ -1,0 +1,78 @@
+"""Pinned repro — XLA:CPU second-step rendezvous deadlock:
+rank-divergent lax.cond inside a ppermute pipeline × ZeRO-1 apply
+collectives (docs/ISSUES.md #1, round-5 bisection).
+
+The pipelined train step wraps each tick's stage compute in
+``lax.cond(valid, stage, passthrough)`` (the 1F1B bubble skip). On
+XLA:CPU with 8 virtual devices, mesh (pipe=2, data=4):
+
+- ZERO=0 (no optimizer-state sharding): 3 steps run fine — the cond
+  itself is sound, fwd+bwd+apply all pass repeatedly.
+- ZERO=1 (optimizer state sharded over `data` → all-gather collectives
+  in the apply): the FIRST step completes, the SECOND deadlocks —
+
+      F rendezvous.cc:127 Termination timeout for `collective permute
+      ...` of 40 seconds exceeded. Expected 8 threads to join the
+      rendezvous, but only 4 of them arrived on time.
+
+  Removing the cond (SKIP=0) fixes it; removing ZeRO-1 fixes it; first
+  execution never deadlocks. The bug needs the cond-divergent pipe
+  groups AND a second collective family (the data-axis gathers) AND a
+  prior execution of the same donated-buffer executable.
+
+On TPU the pattern is standard (no thread-rendezvous execution model),
+so the framework enables the bubble skip on TPU and keeps the
+always-execute form on CPU (`DSTPU_SKIP_BUBBLE` overrides; the ZeRO-0
+cond path is CI-exercised by tests/test_pipeline.py).
+
+Run:   ZERO=1 SKIP=1 python tools/repro_cond_ppermute_deadlock.py  # deadlock
+       ZERO=0 SKIP=1 python tools/repro_cond_ppermute_deadlock.py  # OK
+       ZERO=1 SKIP=0 python tools/repro_cond_ppermute_deadlock.py  # OK
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax.numpy as jnp
+
+import deepspeed_tpu.parallel.pipe.pipeline as pl
+
+SKIP = os.environ.get("SKIP", "1") == "1"
+ZERO = int(os.environ.get("ZERO", "1"))
+pl.default_skip_bubble = lambda: SKIP
+
+from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+from deepspeed_tpu.models.gpt import GPTConfig
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.parallel.pipe import PipelineEngine, gpt_pipe_model
+
+
+def main():
+    cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=32,
+                    num_layers=4, num_heads=2, dropout_rate=0.0,
+                    dtype=jnp.float32)
+    eng = PipelineEngine(gpt_pipe_model(cfg), DeepSpeedTPUConfig({
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": ZERO}}),
+        mesh=build_mesh(data=4, pipe=2))
+    rng = np.random.default_rng(0)
+    b = {"input_ids": rng.integers(0, 128, (4, 4, 32), dtype=np.int32)}
+    losses = [float(eng.train_batch(b)) for _ in range(3)]
+    print(f"OK zero={ZERO} skip={SKIP}", [round(l, 4) for l in losses])
+
+
+if __name__ == "__main__":
+    main()
