@@ -95,12 +95,34 @@ def initialize(args=None,
         # `sparse_gradients: true` makes embedding grads travel as CSR —
         # here the family's embedding_lookup VJP exchanges touched rows
         # over the data axes instead; frozen-dataclass replace, like the
-        # sparse_attention surgery above).
+        # sparse_attention surgery above). The ENGINE's mesh is resolved
+        # here and baked in as (mesh, axes): binding to the ambient
+        # default mesh instead would pick up whatever unrelated engine
+        # registered one first (multi-engine processes — the test suite —
+        # hit exactly that).
         from dataclasses import replace as _dc_replace
 
-        if not model.cfg.sparse_embedding_grad:
-            model = type(model)(cfg=_dc_replace(model.cfg,
-                                                sparse_embedding_grad=True))
+        from jax.sharding import Mesh as _Mesh
+
+        from deepspeed_tpu.parallel.mesh import build_mesh, data_like_axes
+
+        if mesh is None:
+            mesh = build_mesh(data=-1, model=cfg.mesh.model,
+                              pipe=cfg.mesh.pipe,
+                              sequence=cfg.mesh.sequence,
+                              expert=cfg.mesh.expert,
+                              slices=cfg.mesh.slices)
+        current = model.cfg.sparse_embedding_grad
+        already_pinned = (isinstance(current, tuple) and len(current) == 2
+                          and isinstance(current[0], _Mesh))
+        if not already_pinned:
+            # Re-pin True / bare-axes values too: a cfg built with
+            # sparse_embedding_grad=True would otherwise resolve against
+            # the AMBIENT mesh at trace time — the multi-engine footgun.
+            axes = (tuple(current) if isinstance(current, tuple)
+                    and current else data_like_axes(mesh))
+            model = type(model)(cfg=_dc_replace(
+                model.cfg, sparse_embedding_grad=(mesh, axes)))
         sparse_grads_handled = True
         from deepspeed_tpu.utils.logging import log_dist
         log_dist("sparse_gradients: embedding grads exchange touched rows "

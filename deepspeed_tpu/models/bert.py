@@ -142,11 +142,9 @@ class BertModel(nn.Module):
                          (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
         tt = batch.get("token_type_ids")
         tt_emb = tte[tt] if tt is not None else tte[0][None, None]
-        from deepspeed_tpu.ops.embedding import (embedding_lookup,
-                                                 resolve_sparse_grad_axes)
+        from deepspeed_tpu.ops.embedding import embedding_lookup
         tok = embedding_lookup(
-            wte, ids, sparse_grad_axes=resolve_sparse_grad_axes(
-                cfg.sparse_embedding_grad))
+            wte, ids, sparse_grad_axes=cfg.sparse_embedding_grad)
         x = (tok + wpe[:s][None] + tt_emb).astype(cfg.dtype)
         if not cfg.pre_layer_norm:
             x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,

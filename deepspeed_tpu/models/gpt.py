@@ -65,9 +65,9 @@ class GPTConfig:
     embed_grad_matmul: bool = False
     # Row-sparse cross-rank embedding-grad exchange (config
     # `sparse_gradients: true` — reference engine.py:1530-1586):
-    # True = exchange over the data-like mesh axes; or an explicit tuple
-    # of axis names. deepspeed_tpu.initialize() injects this from the
-    # engine config automatically.
+    # (mesh, axes) — what deepspeed_tpu.initialize() bakes in (the
+    # ENGINE's mesh, never the ambient default) — or True / a bare axes
+    # tuple for custom loops (resolved against the ambient mesh).
     sparse_embedding_grad: Any = None
     # Counter-hash activation dropout (ops/dropout.py) instead of flax's
     # threefry bernoulli — the reference's fused-dropout economy
@@ -309,12 +309,10 @@ class GPT(nn.Module):
             pe = wpe[:s][None]
         else:
             pe = jnp.take(wpe, pos + jnp.arange(s), axis=0)[None]
-        from deepspeed_tpu.ops.embedding import (embedding_lookup,
-                                                 resolve_sparse_grad_axes)
+        from deepspeed_tpu.ops.embedding import embedding_lookup
         tok = embedding_lookup(
             wte, ids, matmul_grad=cfg.embed_grad_matmul,
-            sparse_grad_axes=resolve_sparse_grad_axes(
-                cfg.sparse_embedding_grad))
+            sparse_grad_axes=cfg.sparse_embedding_grad)
         x = tok.astype(cfg.dtype) + pe.astype(cfg.dtype)
         x = _dropout_mod(cfg)(cfg.dropout_rate, deterministic=deterministic)(x)
 

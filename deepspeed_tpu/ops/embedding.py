@@ -91,25 +91,34 @@ def _make_lookup_sparse(mesh, axes):
     return lookup
 
 
-def resolve_sparse_grad_axes(setting):
-    """Model-config helper: ``True`` -> the data-like axes of the default
-    mesh with size > 1 (dcn + data); a tuple passes through; falsy ->
-    None (dense grad path)."""
+def resolve_sparse_grad_spec(setting):
+    """Model-config helper -> ``(mesh, axes)`` or None (dense path).
+
+    ``setting`` forms: falsy -> None; ``(mesh, axes)`` (what
+    ``deepspeed_tpu.initialize()`` bakes in — the ENGINE's mesh, pinned
+    at surgery time so the exchange never binds to whatever ambient mesh
+    an unrelated engine registered first); a bare axes tuple or ``True``
+    -> the ambient default mesh (custom-loop use; in a multi-mesh
+    process prefer the explicit form)."""
     if not setting:
         return None
-    if setting is True:
-        from deepspeed_tpu.parallel.mesh import (DATA_AXIS, DCN_AXIS,
-                                                 get_default_mesh)
+    from deepspeed_tpu.parallel.mesh import (DATA_AXIS, DCN_AXIS,
+                                             get_default_mesh)
+    from jax.sharding import Mesh
 
-        mesh = get_default_mesh()
+    if (isinstance(setting, tuple) and len(setting) == 2
+            and isinstance(setting[0], Mesh)):
+        return setting
+    mesh = get_default_mesh()
+    if setting is True:
         if mesh is None:
             return None
-        axes = tuple(a for a in (DCN_AXIS, DATA_AXIS)
-                     if mesh.shape.get(a, 1) > 1)
+        from deepspeed_tpu.parallel.mesh import data_like_axes
+
         # Size-1 everywhere still routes through the sparse path (local
         # scatter only) so the config toggle is honored uniformly.
-        return axes or (DATA_AXIS,)
-    return tuple(setting)
+        return mesh, data_like_axes(mesh)
+    return mesh, tuple(setting)
 
 
 def embedding_lookup(table: jax.Array, ids: jax.Array,
@@ -123,10 +132,11 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
         if matmul_grad:
             raise ValueError("matmul_grad and sparse_grad_axes are "
                              "mutually exclusive embedding-grad paths")
-        from deepspeed_tpu.parallel.mesh import get_default_mesh
-
-        return _make_lookup_sparse(get_default_mesh(),
-                                   tuple(sparse_grad_axes))(table, ids)
+        spec = resolve_sparse_grad_spec(sparse_grad_axes)
+        if spec is None:
+            return jnp.take(table, ids, axis=0)
+        mesh, axes = spec
+        return _make_lookup_sparse(mesh, tuple(axes))(table, ids)
     if matmul_grad:
         return _lookup_matmul_grad(table, ids)
     return jnp.take(table, ids, axis=0)

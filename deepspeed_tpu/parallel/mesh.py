@@ -175,6 +175,16 @@ def data_sharding(mesh: Mesh, batch_axes: Sequence[str] = (DATA_AXIS,)) -> Named
     return NamedSharding(mesh, PartitionSpec(tuple(batch_axes)))
 
 
+def data_like_axes(mesh: Mesh) -> tuple:
+    """The mesh's data-parallel axes with size > 1 (dcn-outer + ici
+    data), falling back to ``(data,)`` on a trivial mesh — the ONE
+    definition of "data-like" shared by the sparse-gradient exchange and
+    the engine surgery."""
+    axes = tuple(a for a in (DCN_AXIS, DATA_AXIS)
+                 if mesh.shape.get(a, 1) > 1)
+    return axes or (DATA_AXIS,)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

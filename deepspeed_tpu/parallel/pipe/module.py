@@ -130,8 +130,7 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
     }
 
     def embed_fn(params, batch, rng):
-        from deepspeed_tpu.ops.embedding import (embedding_lookup,
-                                                 resolve_sparse_grad_axes)
+        from deepspeed_tpu.ops.embedding import embedding_lookup
 
         ids = batch["input_ids"]
         s = ids.shape[1]
@@ -139,8 +138,7 @@ def gpt_pipe_model(cfg, rng_key=None, example_batch=None,
         tok = embedding_lookup(
             emb["wte"], ids,
             matmul_grad=getattr(cfg, "embed_grad_matmul", False),
-            sparse_grad_axes=resolve_sparse_grad_axes(
-                getattr(cfg, "sparse_embedding_grad", None)))
+            sparse_grad_axes=getattr(cfg, "sparse_embedding_grad", None))
         x = tok.astype(cfg.dtype) + emb["wpe"][:s][None].astype(cfg.dtype)
         if rng is not None and cfg.dropout_rate > 0.0:
             keep = jax.random.bernoulli(rng, 1.0 - cfg.dropout_rate, x.shape)

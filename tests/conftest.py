@@ -66,17 +66,22 @@ def _tpu_allclose(actual, desired, rtol=1e-7, atol=0, **kw):
         bad = err > (at + rt * np.abs(d))
         if bad.mean() > 0.01 or (bad.any() and err[bad].max() > 0.1):
             raise
-        # Per-block tail accounting (round-4 VERDICT weak #7): the global
+        # Per-window tail accounting (round-4 VERDICT weak #7): the global
         # 1% allowance must be SCATTERED rounding noise, not one corrupted
         # kernel tile — a localized regression (e.g. a bad 128x128 block
         # in a 16k-seq layout) concentrates its errors in a contiguous
-        # run, so cap the bad fraction per 1024-element block too.
+        # run, so also cap the bad fraction per _TAIL_BLOCK-element
+        # window at 5% (a legitimate lse-rounding ROW at d=128 is 1.6%
+        # of a window; a corrupted tile saturates windows). Limitation:
+        # corruption STRIDED across many heads (64-element stripes every
+        # h*d elements) dilutes below this cap — contiguous-window
+        # accounting can't see row structure from a generic allclose.
         flat = bad.reshape(-1)
         pad = (-flat.size) % _TAIL_BLOCK
         if pad:
             flat = np.concatenate([flat, np.zeros(pad, bool)])
         per_block = flat.reshape(-1, _TAIL_BLOCK).mean(axis=1)
-        if per_block.max() > 0.10:
+        if per_block.max() > 0.05:
             raise AssertionError(
                 f"clustered kernel-parity tail: block "
                 f"{int(per_block.argmax())} has "

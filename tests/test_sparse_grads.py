@@ -102,8 +102,10 @@ class TestSparseGradients:
         """jaxpr-level: the sparse VJP's collective moves the LOCAL token
         rows ([tokens/8, D] per rank), never a [V, ...] operand."""
         from deepspeed_tpu.ops.embedding import embedding_lookup
-        from deepspeed_tpu.parallel.mesh import set_default_mesh
+        from deepspeed_tpu.parallel.mesh import (get_default_mesh,
+                                                 set_default_mesh)
 
+        saved = get_default_mesh()
         mesh = build_mesh(data=8)
         set_default_mesh(mesh)
         table = jnp.zeros((VOCAB, HIDDEN), jnp.float32)
@@ -113,7 +115,10 @@ class TestSparseGradients:
             out = embedding_lookup(t, ids, sparse_grad_axes=("data",))
             return jnp.sum(out * out)
 
-        text = str(jax.make_jaxpr(jax.grad(loss))(table))
+        try:
+            text = str(jax.make_jaxpr(jax.grad(loss))(table))
+        finally:
+            set_default_mesh(saved)
         # the exchange's outputs are the gathered global rows (+ids)...
         tokens = BS * SEQ
         assert re.search(rf"f32\[{tokens},{HIDDEN}\] = all_gather", text)
@@ -137,12 +142,34 @@ class TestSparseGradients:
                     "sparse_gradients": True}),
                 mesh=build_mesh(data=8))
 
+    def test_engine_mesh_pinned_not_ambient(self, eight_devices, rng):
+        """The surgery bakes the ENGINE's mesh into the model config: a
+        stale ambient mesh from an unrelated engine (the multi-engine
+        footgun — an aborted compile in-suite before this fix) must not
+        capture the exchange."""
+        from deepspeed_tpu.parallel.mesh import (get_default_mesh,
+                                                 set_default_mesh)
+
+        saved = get_default_mesh()
+        try:
+            # poison the ambient mesh with mismatched axes
+            set_default_mesh(build_mesh(data=2, pipe=2, sequence=2))
+            mesh = build_mesh(data=8)
+            engine = _engine(mesh, sparse=True)
+            batches = _batches(rng)
+            loss = float(engine.train_batch(batches))
+            assert np.isfinite(loss)
+        finally:
+            set_default_mesh(saved)
+
     def test_op_level_sum_semantics(self, eight_devices, rng):
         """embedding_lookup(sparse_grad_axes) must produce the SAME dense
         cotangent as plain take under a data-sharded batch."""
         from deepspeed_tpu.ops.embedding import embedding_lookup
-        from deepspeed_tpu.parallel.mesh import set_default_mesh
+        from deepspeed_tpu.parallel.mesh import (get_default_mesh,
+                                                 set_default_mesh)
 
+        saved_mesh = get_default_mesh()
         mesh = build_mesh(data=8)
         set_default_mesh(mesh)
         table = jnp.asarray(rng.standard_normal((VOCAB, HIDDEN)),
@@ -158,11 +185,14 @@ class TestSparseGradients:
         from jax.sharding import NamedSharding, PartitionSpec as P
         ids = jax.device_put(ids, NamedSharding(mesh, P("data")))
 
-        g_sparse = jax.jit(jax.grad(loss(
-            lambda t, i: embedding_lookup(
-                t, i, sparse_grad_axes=("data",)))))(table)
-        g_dense = jax.jit(jax.grad(loss(
-            lambda t, i: embedding_lookup(t, i))))(table)
+        try:
+            g_sparse = jax.jit(jax.grad(loss(
+                lambda t, i: embedding_lookup(
+                    t, i, sparse_grad_axes=("data",)))))(table)
+            g_dense = jax.jit(jax.grad(loss(
+                lambda t, i: embedding_lookup(t, i))))(table)
+        finally:
+            set_default_mesh(saved_mesh)
         np.testing.assert_allclose(np.asarray(g_sparse),
                                    np.asarray(g_dense),
                                    rtol=1e-5, atol=1e-5)
