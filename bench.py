@@ -300,8 +300,30 @@ def run_section(name, fn, result, retries=1):
 
 
 def main():
-    dev = jax.devices()[0]
-    platform = dev.platform
+    # Evict any stale partial from a previous run FIRST — before the
+    # backend probe, which is itself a failure mode (round-5: a tunnel
+    # that never comes up dies in jax.devices()). A backend-init failure
+    # must take the same zero-row rc=1 path as an all-sections failure and
+    # must never leave a previous run's BENCH_partial.json masquerading as
+    # this run's record.
+    result = {
+        "metric": "pretrain throughput (backend unavailable)",
+        "value": None,
+        "unit": "samples/sec/chip",
+        "vs_baseline": None,
+    }
+    _flush_partial(result)
+    try:
+        dev = jax.devices()[0]
+        platform = dev.platform
+    except Exception as e:  # noqa: BLE001 — isolate backend init like sections
+        log(f"[bench] backend init failed: {type(e).__name__}: {e}")
+        log(traceback.format_exc())
+        result.setdefault("errors", []).append(
+            f"backend-init: {type(e).__name__}: {e}")
+        _flush_partial(result)
+        print(json.dumps(result))
+        sys.exit(1)
     on_tpu = platform == "tpu"
     peak = PEAK_TFLOPS.get(getattr(dev, "device_kind", ""), 197.0)
 
@@ -310,15 +332,8 @@ def main():
     else:
         steps, warmup = 3, 1
 
-    result = {
-        "metric": f"BERT-{'large' if on_tpu else 'tiny'} seq128 ZeRO-2 "
-                  f"pretrain throughput ({platform})",
-        "value": None,
-        "unit": "samples/sec/chip",
-        "vs_baseline": None,
-    }
-    # Evict any stale partial from a previous run so an early hard crash
-    # can't leave old rows masquerading as this run's record.
+    result["metric"] = (f"BERT-{'large' if on_tpu else 'tiny'} seq128 "
+                        f"ZeRO-2 pretrain throughput ({platform})")
     _flush_partial(result)
 
     def sec_bert128():

@@ -122,6 +122,74 @@ class PLDConfig:
 
 
 @dataclass
+class ResilienceCheckpointConfig:
+    """The async-checkpoint knobs (resilience/checkpoint.py)."""
+
+    dir: str = ""
+    interval: int = C.RESILIENCE_CKPT_INTERVAL_DEFAULT
+    keep_last: int = C.RESILIENCE_CKPT_KEEP_LAST_DEFAULT
+    max_retries: int = C.RESILIENCE_CKPT_MAX_RETRIES_DEFAULT
+    backoff_seconds: float = C.RESILIENCE_CKPT_BACKOFF_DEFAULT
+    async_write: bool = C.RESILIENCE_CKPT_ASYNC_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ResilienceCheckpointConfig":
+        d = d or {}
+        cfg = cls(
+            dir=str(_get(d, C.RESILIENCE_CKPT_DIR, "")),
+            interval=int(_get(d, C.RESILIENCE_CKPT_INTERVAL,
+                              C.RESILIENCE_CKPT_INTERVAL_DEFAULT)),
+            keep_last=int(_get(d, C.RESILIENCE_CKPT_KEEP_LAST,
+                               C.RESILIENCE_CKPT_KEEP_LAST_DEFAULT)),
+            max_retries=int(_get(d, C.RESILIENCE_CKPT_MAX_RETRIES,
+                                 C.RESILIENCE_CKPT_MAX_RETRIES_DEFAULT)),
+            backoff_seconds=float(_get(d, C.RESILIENCE_CKPT_BACKOFF,
+                                       C.RESILIENCE_CKPT_BACKOFF_DEFAULT)),
+            async_write=bool(_get(d, C.RESILIENCE_CKPT_ASYNC,
+                                  C.RESILIENCE_CKPT_ASYNC_DEFAULT)),
+        )
+        if cfg.interval < 1:
+            raise ConfigError("resilience.checkpoint.interval must be >= 1")
+        if cfg.keep_last < 1:
+            raise ConfigError("resilience.checkpoint.keep_last must be >= 1")
+        if cfg.max_retries < 0:
+            raise ConfigError("resilience.checkpoint.max_retries must be >= 0")
+        return cfg
+
+
+@dataclass
+class ResilienceConfig:
+    """Preemption-aware training (resilience/): auto checkpointing every
+    ``checkpoint.interval`` steps off the step path, auto-resume from the
+    newest complete manifest, and a deterministic fault-injection plan
+    (``fault_injection`` keys = FaultPlan fields; ``DSTPU_FAULT_PLAN`` env
+    JSON overrides them)."""
+
+    enabled: bool = False
+    checkpoint: ResilienceCheckpointConfig = field(
+        default_factory=ResilienceCheckpointConfig)
+    auto_resume: bool = C.RESILIENCE_AUTO_RESUME_DEFAULT
+    fault_injection: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ResilienceConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.RESILIENCE_ENABLED, False)),
+            checkpoint=ResilienceCheckpointConfig.from_dict(
+                d.get(C.RESILIENCE_CHECKPOINT)),
+            auto_resume=bool(_get(d, C.RESILIENCE_AUTO_RESUME,
+                                  C.RESILIENCE_AUTO_RESUME_DEFAULT)),
+            fault_injection=dict(d.get(C.RESILIENCE_FAULT_INJECTION) or {}),
+        )
+        if cfg.enabled and not cfg.checkpoint.dir:
+            raise ConfigError(
+                "resilience.enabled requires resilience.checkpoint.dir "
+                "(where manifests/shards are committed)")
+        return cfg
+
+
+@dataclass
 class MeshConfig:
     """Named parallel axes. Sizes of 1 mean the axis is unused.
 
@@ -298,6 +366,7 @@ class DeepSpeedTPUConfig:
         self.pld = PLDConfig.from_dict(d.get(C.PROGRESSIVE_LAYER_DROP))
         self.aio = AIOConfig.from_dict(d.get(C.AIO))
         self.tensorboard = TensorboardConfig.from_dict(d.get(C.TENSORBOARD))
+        self.resilience = ResilienceConfig.from_dict(d.get(C.RESILIENCE))
         self.sparse_attention = d.get(C.SPARSE_ATTENTION)
         self.pipeline = dict(d.get(C.PIPELINE, {}))
         self.eigenvalue = dict(d.get(C.EIGENVALUE, {}))

@@ -77,6 +77,28 @@ SPARSE_GRADIENTS = "sparse_gradients"
 SPARSE_GRADIENTS_DEFAULT = False
 
 #############################################
+# Resilience (TPU-native block, no reference analogue: preemption-aware
+# async checkpointing + fault injection + auto-resume, resilience/)
+#############################################
+RESILIENCE = "resilience"
+RESILIENCE_ENABLED = "enabled"
+RESILIENCE_CHECKPOINT = "checkpoint"
+RESILIENCE_CKPT_DIR = "dir"
+RESILIENCE_CKPT_INTERVAL = "interval"
+RESILIENCE_CKPT_INTERVAL_DEFAULT = 100
+RESILIENCE_CKPT_KEEP_LAST = "keep_last"
+RESILIENCE_CKPT_KEEP_LAST_DEFAULT = 3
+RESILIENCE_CKPT_MAX_RETRIES = "max_retries"
+RESILIENCE_CKPT_MAX_RETRIES_DEFAULT = 3
+RESILIENCE_CKPT_BACKOFF = "backoff_seconds"
+RESILIENCE_CKPT_BACKOFF_DEFAULT = 0.5
+RESILIENCE_CKPT_ASYNC = "async"
+RESILIENCE_CKPT_ASYNC_DEFAULT = True
+RESILIENCE_AUTO_RESUME = "auto_resume"
+RESILIENCE_AUTO_RESUME_DEFAULT = True
+RESILIENCE_FAULT_INJECTION = "fault_injection"
+
+#############################################
 # Logging / misc
 #############################################
 STEPS_PER_PRINT = "steps_per_print"

@@ -6,9 +6,11 @@ from deepspeed_tpu.elasticity.elasticity import (
     ElasticityError,
     ElasticityIncompatibleWorldSize,
     compute_elastic_config,
+    elastic_config_hash,
     elasticity_enabled,
     ensure_immutable_elastic_config,
     highly_composite_numbers,
+    pick_preferred_world,
 )
 
 # Reference exposes errors under deepspeed.elasticity.config as well.
@@ -17,6 +19,7 @@ from deepspeed_tpu.elasticity import elasticity as config  # noqa: F401
 __all__ = [
     "ElasticityConfig", "ElasticityConfigError", "ElasticityError",
     "ElasticityIncompatibleWorldSize", "compute_elastic_config",
-    "elasticity_enabled", "ensure_immutable_elastic_config",
-    "highly_composite_numbers", "config",
+    "elastic_config_hash", "elasticity_enabled",
+    "ensure_immutable_elastic_config", "highly_composite_numbers",
+    "pick_preferred_world", "config",
 ]

@@ -191,6 +191,44 @@ def elasticity_enabled(ds_config: Dict) -> bool:
     return bool(ds_config.get(ELASTICITY_KEY, {}).get("enabled", False))
 
 
+def elastic_config_hash(elastic_block: Optional[Dict]) -> str:
+    """Stable fingerprint of the convergence-relevant elastic keys.
+
+    Recorded in every checkpoint manifest (resilience/checkpoint.py) and
+    re-checked on auto-resume: two worlds may differ in chip count, but if
+    they disagree on the batch math the resumed trajectory is a different
+    experiment and the restore must refuse. Empty string when elasticity is
+    off (nothing to pin — resume only requires matching state shapes)."""
+    if not elastic_block or not elastic_block.get("enabled", False):
+        return ""
+    ecfg = ElasticityConfig(dict(elastic_block))
+    canon = json.dumps({
+        "max_train_batch_size": ecfg.max_acceptable_batch_size,
+        "micro_batch_sizes": sorted(ecfg.micro_batches),
+        "min_chips": ecfg.min_chips,
+        "max_chips": ecfg.max_chips,
+        "version": ecfg.version,
+    }, sort_keys=True)
+    import hashlib
+
+    return hashlib.sha1(canon.encode()).hexdigest()
+
+
+def pick_preferred_world(ds_config: Dict, available_chips: int,
+                         target_version: str = __version__) -> int:
+    """The largest valid elastic world size <= ``available_chips`` — the
+    supervisor's restart-time world selection when chips were lost to
+    preemption. Raises ElasticityIncompatibleWorldSize when no rung of the
+    ladder fits the surviving capacity."""
+    _, valid = compute_elastic_config(ds_config, target_version)
+    fitting = [w for w in valid if w <= available_chips]
+    if not fitting:
+        raise ElasticityIncompatibleWorldSize(
+            f"no valid elastic world size <= {available_chips} chips "
+            f"(ladder: {valid})")
+    return max(fitting)
+
+
 def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict) -> None:
     """Cross-check the runtime elastic config against the one the resource
     scheduler used (env ``DEEPSPEED_ELASTICITY_CONFIG``); they must agree on

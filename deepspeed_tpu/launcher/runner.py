@@ -50,6 +50,14 @@ def parse_args(args=None):
                         help="Multi-node backend")
     parser.add_argument("--force_multi", action="store_true",
                         help="Treat as multi-node even for one host")
+    parser.add_argument("--auto_resume", action="store_true",
+                        help="Supervise the job: restart it after a worker "
+                             "death (preemption, crash) up to "
+                             "--max_restarts times; restarted workers see "
+                             "DSTPU_RESUME_ATTEMPT and resume from the "
+                             "newest complete resilience checkpoint")
+    parser.add_argument("--max_restarts", type=int, default=3,
+                        help="Restart budget for --auto_resume")
     parser.add_argument("user_script", type=str,
                         help="User training script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -234,42 +242,62 @@ def main(args=None):
     if not multi_node:
         cmd = build_host_command(0, active, args, env)
         logger.info("single-node launch: %s", " ".join(map(shlex.quote, cmd)))
+        if args.auto_resume:
+            # The launcher-level recovery loop (resilience/supervisor.py):
+            # restart on death; the resumed incarnation reads the newest
+            # complete manifest via engine.auto_resume().
+            from deepspeed_tpu.resilience import Supervisor
+            sys.exit(Supervisor(cmd, max_restarts=args.max_restarts,
+                                env=env).run())
         result = subprocess.run(cmd, env={**os.environ, **env})
         sys.exit(result.returncode)
 
-    if args.launcher in ("openmpi", "mpich", "mvapich"):
-        cmd = build_mpi_command(active, args, env)
-        logger.info("mpi launch: %s", " ".join(map(shlex.quote, cmd)))
-        result = subprocess.run(cmd, env={**os.environ, **env})
-        sys.exit(result.returncode)
+    def launch_once(attempt_env: Dict[str, str]) -> int:
+        env_a = {**env, **attempt_env}
+        if args.launcher in ("openmpi", "mpich", "mvapich"):
+            cmd = build_mpi_command(active, args, env_a)
+            logger.info("mpi launch: %s", " ".join(map(shlex.quote, cmd)))
+            return subprocess.run(cmd, env={**os.environ, **env_a}).returncode
 
-    # multi-node: one remote command per host over ssh/pdsh
-    procs = []
-    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-    for idx, host in enumerate(hosts):
-        cmd = build_host_command(idx, active, args, env)
-        remote = f"cd {shlex.quote(os.getcwd())} && {exports} " + \
-            " ".join(map(shlex.quote, cmd))
-        if args.launcher == "pdsh":
-            full = ["pdsh", "-w", host, remote]
-        else:
-            full = ["ssh", host, remote]
-        logger.info("launching on %s: %s", host, remote)
-        procs.append(subprocess.Popen(full))
+        # multi-node: one remote command per host over ssh/pdsh
+        procs = []
+        exports = " ".join(f"{k}={shlex.quote(v)}"
+                           for k, v in env_a.items())
+        for idx, host in enumerate(hosts):
+            cmd = build_host_command(idx, active, args, env_a)
+            remote = f"cd {shlex.quote(os.getcwd())} && {exports} " + \
+                " ".join(map(shlex.quote, cmd))
+            if args.launcher == "pdsh":
+                full = ["pdsh", "-w", host, remote]
+            else:
+                full = ["ssh", host, remote]
+            logger.info("launching on %s: %s", host, remote)
+            procs.append(subprocess.Popen(full))
 
-    def remote_kill():
-        # Killing the local ssh/pdsh client does not reliably reach the
-        # remote workers (no tty) — issue an explicit best-effort remote
-        # pkill, the reference runner's abort path.
-        pat = shlex.quote(f"deepspeed_tpu.launcher.launch.*{args.user_script}")
-        for host in hosts:
-            try:
-                subprocess.run(["ssh", host, f"pkill -f {pat}"],
-                               timeout=10, capture_output=True)
-            except Exception:
-                pass
+        def remote_kill():
+            # Killing the local ssh/pdsh client does not reliably reach the
+            # remote workers (no tty) — issue an explicit best-effort remote
+            # pkill, the reference runner's abort path.
+            pat = shlex.quote(
+                f"deepspeed_tpu.launcher.launch.*{args.user_script}")
+            for host in hosts:
+                try:
+                    subprocess.run(["ssh", host, f"pkill -f {pat}"],
+                                   timeout=10, capture_output=True)
+                except Exception:
+                    pass
 
-    sys.exit(babysit(procs, on_failure=remote_kill))
+        return babysit(procs, on_failure=remote_kill)
+
+    rc = launch_once({})
+    restarts = 0
+    while rc != 0 and args.auto_resume and restarts < args.max_restarts:
+        restarts += 1
+        logger.warning("job died rc=%s — auto-resume restart %d/%d",
+                       rc, restarts, args.max_restarts)
+        from deepspeed_tpu.resilience import RESUME_ATTEMPT_ENV
+        rc = launch_once({RESUME_ATTEMPT_ENV: str(restarts)})
+    sys.exit(rc)
 
 
 def babysit(procs, poll_interval: float = 0.5, term_timeout: float = 10.0,

@@ -132,28 +132,28 @@ def _use_fused_ln(cfg, x) -> frozenset:
     """Dispatch for the fused LN+projection path (GPTConfig.fused_ln):
     returns the set of fused sites ("qkv", "mlp"). "auto" = both on TPU
     when shapes tile; True forces both (Pallas interpret off-TPU — parity
-    tests); "qkv"/"mlp" select one site; False = unfused flax modules."""
+    tests); "qkv"/"mlp" select one site; False = unfused flax modules.
+
+    Mode validation comes FIRST — a typo must always raise, never silently
+    train unfused just because shapes happen not to tile. Each site is then
+    shape-gated independently: an untileable mlp dim no longer disables a
+    requested (and tileable) qkv fusion, and vice versa."""
     mode = getattr(cfg, "fused_ln", False)
     if mode is False or mode is None:
+        return frozenset()
+    if mode is not True and mode not in ("auto", "qkv", "mlp"):
+        raise ValueError(f"unknown fused_ln value {mode!r}: expected False, "
+                         "True, 'auto', 'qkv', or 'mlp'")
+    if mode == "auto" and jax.devices()[0].platform != "tpu":
         return frozenset()
     from deepspeed_tpu.ops.transformer.fused import ln_matmul_ok
 
     n = x.shape[0] * x.shape[1]
-    ok = (ln_matmul_ok(n, cfg.hidden_size, 3 * cfg.hidden_size)
-          and ln_matmul_ok(n, cfg.hidden_size,
-                           cfg.mlp_ratio * cfg.hidden_size))
-    if not ok:
-        return frozenset()
-    if mode == "auto":
-        if jax.devices()[0].platform != "tpu":
-            return frozenset()
-        return frozenset(("qkv", "mlp"))
-    if mode is True:
-        return frozenset(("qkv", "mlp"))
-    if mode in ("qkv", "mlp"):
-        return frozenset((mode,))
-    raise ValueError(f"unknown fused_ln value {mode!r}: expected False, "
-                     "True, 'auto', 'qkv', or 'mlp'")
+    want = ("qkv", "mlp") if mode in (True, "auto") else (mode,)
+    out_dim = {"qkv": 3 * cfg.hidden_size,
+               "mlp": cfg.mlp_ratio * cfg.hidden_size}
+    return frozenset(s for s in want
+                     if ln_matmul_ok(n, cfg.hidden_size, out_dim[s]))
 
 
 class GPTBlock(nn.Module):

@@ -57,7 +57,8 @@ def init_distributed(dist_backend: str = "xla",
     # NB: must not touch jax.devices()/process_count() here — any backend
     # query initialises the local runtime and jax.distributed.initialize
     # would then be too late.
-    if jax.distributed.is_initialized():
+    from deepspeed_tpu.utils.jax_compat import distributed_is_initialized
+    if distributed_is_initialized():
         return
     coordinator_address = coordinator_address or os.environ.get("DSTPU_COORDINATOR")
     if coordinator_address is None and "MASTER_ADDR" in os.environ:

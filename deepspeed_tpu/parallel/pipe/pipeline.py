@@ -31,7 +31,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deepspeed_tpu.parallel.mesh import PIPE_AXIS
@@ -88,7 +88,8 @@ def pipeline_apply_manual(block_fn: Callable,
                           broadcast_output: bool = True,
                           pass_layer_idx: bool = False,
                           block_aux: bool = False,
-                          skip_bubble: Optional[bool] = None):
+                          skip_bubble: Optional[bool] = None,
+                          rank: Optional[jax.Array] = None):
     """The manual-region pipeline body: call INSIDE a shard_map already
     manual over ``pipe`` (``stage_blocks`` leaves carry the local
     ``[L/S, ...]`` shard; ``x_all`` ``[M, mb, ...]`` is pipe-replicated).
@@ -164,7 +165,12 @@ def pipeline_apply_manual(block_fn: Callable,
         return (out, jnp.sum(auxs)) if block_aux else out
 
     T = M + stages - 1
-    rank = jax.lax.axis_index(PIPE_AXIS)
+    if rank is None:
+        # Fine under a fully-manual caller; the partial-manual
+        # pipeline_apply path passes a sharded-iota rank instead because
+        # old jax lowers axis_index there to a PartitionId HLO the SPMD
+        # partitioner rejects (utils/jax_compat.py).
+        rank = jax.lax.axis_index(PIPE_AXIS)
     shift = [(i, (i + 1) % stages) for i in range(stages)]
 
     def tick(carry, t):
@@ -273,21 +279,33 @@ def pipeline_apply(block_fn: Callable,
                                      block_aux=block_aux,
                                      skip_bubble=skip_bubble)
 
+    from deepspeed_tpu.utils.jax_compat import NATIVE_SHARD_MAP
+    if not NATIVE_SHARD_MAP:
+        # Old jax: the partial-manual pipeline program crashes (C-level
+        # abort) this XLA CPU backend during compilation. Fail as a
+        # catchable error instead of killing the host process.
+        raise NotImplementedError(
+            "pipeline parallelism (stages > 1) requires a jax with native "
+            "shard_map; this jax's XLA backend aborts compiling the "
+            "partial-manual pipeline program")
+
     compute_dtype = x.dtype
 
-    def pipelined(stage_blocks, x_all, aux_all, keys):
+    def pipelined(stage_blocks, x_all, aux_all, keys, rank_arr):
         # stage_blocks leaves: [L/S, ...] (pipe dim stripped; other axes
         # remain GSPMD-auto); x_all: [M, mb, ...] replicated across pipe.
         # x crosses the shard_map boundary in fp32 (see psum note in
         # pipeline_apply_manual: the cotangent of a pipe-replicated input
         # is a psum, which must not run in bf16 under a partial-manual
-        # shard_map).
+        # shard_map). rank_arr is a pipe-sharded iota, so its single local
+        # element IS this shard's stage index — the axis_index equivalent
+        # that survives old-jax partial-manual lowering.
         return pipeline_apply_manual(
             block_fn, stage_blocks, x_all.astype(compute_dtype), aux_all,
             keys, stages=stages, num_microbatches=M,
             remat_blocks=remat_blocks, broadcast_output=True,
             pass_layer_idx=pass_layer_idx, block_aux=block_aux,
-            skip_bubble=skip_bubble)
+            skip_bubble=skip_bubble, rank=rank_arr[0])
 
     blocks_treedef = jax.tree_util.tree_structure(blocks_params)
     blocks_ndims = tuple(l.ndim for l in jax.tree_util.tree_leaves(blocks_params))
@@ -301,11 +319,13 @@ def pipeline_apply(block_fn: Callable,
             return shard_map(
                 pipelined,
                 mesh=mesh,
-                in_specs=(pipeline_spec(blocks_arg), P(), P(), P()),
+                in_specs=(pipeline_spec(blocks_arg), P(), P(), P(),
+                          P(PIPE_AXIS)),
                 out_specs=(P(), P()) if block_aux else P(),
                 axis_names={PIPE_AXIS},
                 check_vma=False,
-            )(blocks_arg, x_arg, aux_arg, rng_arg)
+            )(blocks_arg, x_arg, aux_arg, rng_arg,
+              jnp.arange(stages, dtype=jnp.int32))
 
         # Partial-manual shard_map only traces under jit; the jit also makes
         # repeated eager calls hit the compile cache.

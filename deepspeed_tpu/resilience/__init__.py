@@ -1,0 +1,20 @@
+"""Resilience subsystem: preemption-aware async checkpointing, deterministic
+fault injection, and elastic auto-resume (see docs/RESILIENCE.md)."""
+
+from deepspeed_tpu.resilience.checkpoint import (AsyncCheckpointManager,
+                                                 ResilienceError,
+                                                 find_restorable,
+                                                 list_checkpoints, restore,
+                                                 snapshot_engine)
+from deepspeed_tpu.resilience.fault import (FAULT_PLAN_ENV,
+                                            RESUME_ATTEMPT_ENV, FaultPlan,
+                                            corrupt_one_shard)
+from deepspeed_tpu.resilience.supervisor import (ELASTIC_WORLD_ENV,
+                                                 Supervisor, supervise_main)
+
+__all__ = [
+    "AsyncCheckpointManager", "ResilienceError", "find_restorable",
+    "list_checkpoints", "restore", "snapshot_engine",
+    "FaultPlan", "corrupt_one_shard", "FAULT_PLAN_ENV", "RESUME_ATTEMPT_ENV",
+    "Supervisor", "supervise_main", "ELASTIC_WORLD_ENV",
+]

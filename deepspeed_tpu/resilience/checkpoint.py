@@ -1,0 +1,475 @@
+"""Preemption-aware asynchronous checkpointing.
+
+The engine's orbax path (``runtime/checkpointing.py``) is synchronous and
+best-effort: a save blocks the step loop for the full serialize+write, and a
+death mid-write can leave a directory that *looks* like a checkpoint. This
+module is the production recovery tier:
+
+- **off the step path** — ``save()`` only snapshots device state to host
+  (async D2H started leaf-by-leaf, then gathered) and enqueues; a background
+  writer thread does the serialization and disk I/O;
+- **double-buffered** — at most one snapshot is in flight and one pending;
+  enqueueing while a write runs *replaces* the pending snapshot (latest
+  wins), so a slow disk back-pressures to "skip intermediate checkpoints",
+  never "stall training";
+- **atomic commit** — shards + manifest are written into a ``.tmp-`` dir
+  which is ``os.replace``d into place; a directory named ``step_*`` with a
+  parseable manifest therefore IS a complete checkpoint, and a death
+  mid-write leaves only a tmp dir the loader never considers;
+- **verified** — the manifest records a sha256 per shard (plus shape/dtype
+  and the elastic-config hash); the loader re-hashes on restore and falls
+  back to the previous complete checkpoint on any mismatch (torn shard,
+  bitrot, truncation);
+- **retried** — transient write failures retry with exponential backoff
+  (``max_retries``/``backoff``), with :class:`~.fault.FaultPlan` able to
+  inject the failures deterministically;
+- **garbage-collected** — keep-last-N, applied after every commit.
+
+Restore (:func:`restore`) places each saved leaf onto the *restoring*
+engine's shardings. Shards store the full (gathered) arrays, so an elastic
+restart at a different world size reshards ZeRO state by construction — the
+device_put against the new engine's NamedShardings is the reshard the
+cross-replica-sharding paper's weight-update partitioning needs on recovery.
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import shutil
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.resilience.fault import (RESUME_ATTEMPT_ENV, FaultPlan,
+                                            corrupt_one_shard)
+from deepspeed_tpu.utils.logging import logger
+
+MANIFEST_FILE = "manifest.json"
+CLIENT_STATE_FILE = "client_state.pkl"
+METRICS_FILE = "resilience_metrics.jsonl"
+MANIFEST_FORMAT = 1
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+_TMP_PREFIX = ".tmp-"
+
+
+class ResilienceError(RuntimeError):
+    pass
+
+
+def _leaf_name(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "name"):       # GetAttrKey (NamedTuple fields)
+            parts.append(str(k.name))
+        elif hasattr(k, "key"):      # DictKey
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):      # SequenceKey
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return ".".join(parts)
+
+
+def _flatten_named(tree) -> Tuple[List[Tuple[str, Any]], Any]:
+    """[(dotted-name, leaf)], treedef — names are stable for a fixed
+    TrainState structure, which save and restore both derive from the
+    engine, so matching by name is exact."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [(_leaf_name(p), leaf) for p, leaf in flat], treedef
+
+
+def _storable(arr: np.ndarray) -> Tuple[np.ndarray, str]:
+    """(numpy-native array, original dtype string). bfloat16 (and other
+    ml_dtypes floats numpy can't round-trip by name) are stored widened to
+    fp32; restore casts back to the template leaf's dtype — lossless.
+    (No ascontiguousarray: it promotes 0-d scalars to 1-d, and ``tobytes``
+    emits C order regardless.)"""
+    arr = np.asarray(arr)
+    orig = str(arr.dtype)
+    try:
+        np.dtype(orig)
+    except TypeError:
+        arr = arr.astype(np.float32)
+    return arr, orig
+
+
+class _Snapshot:
+    """Host-side copy of everything a resume needs, ready to serialize."""
+
+    def __init__(self, step: int, arrays: List[Tuple[str, np.ndarray]],
+                 meta: Dict[str, Any], client_state: Dict[str, Any]):
+        self.step = step
+        self.arrays = arrays
+        self.meta = meta
+        self.client_state = client_state
+
+
+def snapshot_engine(engine, client_state: Optional[Dict] = None) -> _Snapshot:
+    """Copy engine state to host. Starts every leaf's D2H copy before
+    gathering any (overlapped transfers), so the step-path cost is one
+    device sync + the copies — no disk I/O."""
+    import jax
+
+    state = engine._snapshot_state()
+    named, _ = _flatten_named(state)
+    for _, leaf in named:
+        if hasattr(leaf, "copy_to_host_async"):
+            leaf.copy_to_host_async()
+    arrays = [(name, np.asarray(jax.device_get(leaf)))
+              for name, leaf in named]
+    meta = {
+        "format": MANIFEST_FORMAT,
+        "step": int(engine.global_steps),
+        "micro_steps": int(engine.micro_steps),
+        "elastic_hash": getattr(engine, "elastic_hash", ""),
+        "world_size": int(engine.mesh.size),
+        "dp_world_size": int(engine.dp_size),
+        "zero_stage": int(engine.config.zero_config.stage),
+        "ds_version": _version(),
+        "lr_scheduler": (engine.lr_scheduler.state_dict()
+                         if engine.lr_scheduler is not None else None),
+    }
+    return _Snapshot(meta["step"], arrays, meta, client_state or {})
+
+
+def _version() -> str:
+    from deepspeed_tpu.version import __version__
+
+    return __version__
+
+
+class AsyncCheckpointManager:
+    """Background double-buffered checkpoint writer. One per engine."""
+
+    def __init__(self,
+                 ckpt_dir: str,
+                 interval: int = 1,
+                 keep_last: int = 3,
+                 max_retries: int = 3,
+                 backoff: float = 0.05,
+                 async_write: bool = True,
+                 fault_plan: Optional[FaultPlan] = None,
+                 monitor=None):
+        if interval < 1:
+            raise ValueError("checkpoint interval must be >= 1")
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        self.ckpt_dir = ckpt_dir
+        self.interval = int(interval)
+        self.keep_last = int(keep_last)
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.async_write = bool(async_write)
+        self.fault_plan = fault_plan
+        self.monitor = monitor
+        self.stats = {"saved": 0, "dropped": 0, "retries": 0, "failed": 0}
+        self.last_error: Optional[BaseException] = None
+        os.makedirs(ckpt_dir, exist_ok=True)
+        from deepspeed_tpu.utils.monitor import MetricsJSONL
+        self.metrics = MetricsJSONL(os.path.join(ckpt_dir, METRICS_FILE))
+
+        self._cv = threading.Condition()
+        self._pending: Optional[_Snapshot] = None
+        self._writing = False
+        self._closed = False
+        # Test hook: clear to hold the writer before it takes a snapshot
+        # (makes the latest-wins double-buffer observable deterministically).
+        self._unpaused = threading.Event()
+        self._unpaused.set()
+        self._thread = None
+        if self.async_write:
+            self._thread = threading.Thread(
+                target=self._writer_loop, name="ckpt-writer", daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    def save(self, engine, client_state: Optional[Dict] = None,
+             blocking: bool = False) -> None:
+        """Snapshot now; write in the background (or inline when
+        ``async_write=False``). Never raises for write errors — the writer
+        retries, and terminal failures land in ``stats['failed']`` /
+        ``last_error`` plus the log (checkpointing must not kill the run
+        it exists to protect)."""
+        t0 = time.monotonic()
+        snap = snapshot_engine(engine, client_state=client_state)
+        snap.meta["snapshot_sec"] = round(time.monotonic() - t0, 6)
+        if not self.async_write:
+            self._write_with_retries(snap)
+            return
+        with self._cv:
+            if self._closed:
+                raise ResilienceError("AsyncCheckpointManager is closed")
+            if self._pending is not None:
+                # Double buffer: one writing + one pending; latest wins.
+                self.stats["dropped"] += 1
+                logger.warning(
+                    "async checkpoint backlog: dropping pending step %d "
+                    "snapshot in favour of step %d", self._pending.step,
+                    snap.step)
+            self._pending = snap
+            self._cv.notify_all()
+        if blocking:
+            self.wait()
+
+    def wait(self) -> None:
+        """Drain: returns once no snapshot is pending or being written."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._pending is None and not self._writing)
+
+    def close(self) -> None:
+        if self._thread is None:
+            return
+        self.wait()
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=30)
+        self.metrics.close()
+
+    # ------------------------------------------------------------------
+    def _writer_loop(self) -> None:
+        while True:
+            self._unpaused.wait()
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._pending is not None or self._closed)
+                if self._pending is None and self._closed:
+                    return
+                snap, self._pending = self._pending, None
+                self._writing = True
+                self._cv.notify_all()
+            try:
+                self._write_with_retries(snap)
+            finally:
+                with self._cv:
+                    self._writing = False
+                    self._cv.notify_all()
+
+    def _write_with_retries(self, snap: _Snapshot) -> None:
+        t0 = time.monotonic()
+        for attempt in range(self.max_retries + 1):
+            try:
+                path = self._write_once(snap)
+                break
+            except Exception as e:  # noqa: BLE001 — retry any write fault
+                self.last_error = e
+                if attempt >= self.max_retries:
+                    self.stats["failed"] += 1
+                    logger.error(
+                        "checkpoint step %d failed after %d attempts: %s",
+                        snap.step, attempt + 1, e)
+                    return
+                self.stats["retries"] += 1
+                delay = self.backoff * (2 ** attempt)
+                logger.warning(
+                    "checkpoint step %d write attempt %d failed (%s); "
+                    "retrying in %.3fs", snap.step, attempt + 1, e, delay)
+                time.sleep(delay)
+        latency = time.monotonic() - t0
+        self.stats["saved"] += 1
+        self.metrics.add_scalar("Train/Checkpoint/write_latency_sec",
+                                latency, snap.step)
+        self.metrics.add_scalar("Train/Checkpoint/snapshot_sec",
+                                snap.meta.get("snapshot_sec", 0.0), snap.step)
+        if self.monitor is not None:
+            self.monitor.add_scalar("Train/Checkpoint/write_latency_sec",
+                                    latency, snap.step)
+        logger.info("checkpoint step %d committed to %s (%.3fs)",
+                    snap.step, path, latency)
+        if (self.fault_plan is not None
+                and self.fault_plan.should_corrupt(snap.step)):
+            manifest = _read_manifest(path)
+            corrupt_one_shard(path, manifest)
+        self._gc()
+
+    def _write_once(self, snap: _Snapshot) -> str:
+        final = os.path.join(self.ckpt_dir, f"step_{snap.step:08d}")
+        tmp = os.path.join(self.ckpt_dir, f"{_TMP_PREFIX}step_{snap.step:08d}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)  # leftover from a failed earlier attempt
+        os.makedirs(tmp)
+        shards: Dict[str, Dict[str, Any]] = {}
+        for i, (name, arr) in enumerate(snap.arrays):
+            stored, orig_dtype = _storable(arr)
+            fname = f"shard_{i:05d}.bin"
+            data = stored.tobytes()
+            if (self.fault_plan is not None
+                    and self.fault_plan.take_io_error()):
+                raise OSError(f"injected checkpoint I/O error ({fname})")
+            with open(os.path.join(tmp, fname), "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            shards[name] = {
+                "file": fname,
+                "sha256": hashlib.sha256(data).hexdigest(),
+                "shape": list(stored.shape),
+                "stored_dtype": str(stored.dtype),
+                "dtype": orig_dtype,
+            }
+        cs_blob = pickle.dumps(snap.client_state)
+        with open(os.path.join(tmp, CLIENT_STATE_FILE), "wb") as f:
+            f.write(cs_blob)
+            f.flush()
+            os.fsync(f.fileno())
+        manifest = dict(snap.meta)
+        manifest["shards"] = shards
+        manifest["client_state_sha256"] = hashlib.sha256(cs_blob).hexdigest()
+        with open(os.path.join(tmp, MANIFEST_FILE), "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.isdir(final):
+            shutil.rmtree(final)  # re-save of the same step supersedes
+        os.replace(tmp, final)    # the atomic commit
+        return final
+
+    def _gc(self) -> None:
+        entries = list_checkpoints(self.ckpt_dir)
+        for _, path in entries[:-self.keep_last]:
+            shutil.rmtree(path, ignore_errors=True)
+            logger.info("checkpoint GC: removed %s", path)
+
+
+# ---------------------------------------------------------------------------
+# Load / resume side
+# ---------------------------------------------------------------------------
+
+def list_checkpoints(ckpt_dir: str) -> List[Tuple[int, str]]:
+    """[(step, path)] of committed checkpoints, oldest first. Tmp dirs from
+    a death mid-write never match (the rename-commit contract)."""
+    out = []
+    if not os.path.isdir(ckpt_dir):
+        return out
+    for entry in os.listdir(ckpt_dir):
+        m = _STEP_DIR_RE.match(entry)
+        if m:
+            out.append((int(m.group(1)), os.path.join(ckpt_dir, entry)))
+    return sorted(out)
+
+
+def _read_manifest(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, MANIFEST_FILE)) as f:
+        return json.load(f)
+
+
+def _load_verified(path: str):
+    """Read + digest-verify every shard of one checkpoint. Raises on any
+    mismatch/corruption — the caller falls back to an older checkpoint."""
+    manifest = _read_manifest(path)
+    if manifest.get("format") != MANIFEST_FORMAT:
+        raise ResilienceError(
+            f"unsupported manifest format {manifest.get('format')}")
+    arrays: Dict[str, np.ndarray] = {}
+    for name, rec in manifest["shards"].items():
+        fname = os.path.join(path, rec["file"])
+        with open(fname, "rb") as f:
+            data = f.read()
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != rec["sha256"]:
+            raise ResilienceError(
+                f"shard {name!r} digest mismatch in {path} "
+                f"({digest[:12]} != {rec['sha256'][:12]}): torn or corrupt")
+        arr = np.frombuffer(data, dtype=np.dtype(rec["stored_dtype"]))
+        arrays[name] = arr.reshape(rec["shape"])
+    cs_path = os.path.join(path, CLIENT_STATE_FILE)
+    client_state: Dict[str, Any] = {}
+    if os.path.exists(cs_path):
+        with open(cs_path, "rb") as f:
+            blob = f.read()
+        if (hashlib.sha256(blob).hexdigest()
+                != manifest.get("client_state_sha256")):
+            raise ResilienceError(f"client_state digest mismatch in {path}")
+        client_state = pickle.loads(blob)
+    return manifest, arrays, client_state
+
+
+def find_restorable(ckpt_dir: str):
+    """Newest *complete, digest-verified* checkpoint, falling back past any
+    corrupt/torn ones. Returns (path, manifest, arrays, client_state) or
+    None when nothing usable exists."""
+    for step, path in reversed(list_checkpoints(ckpt_dir)):
+        try:
+            manifest, arrays, client_state = _load_verified(path)
+            return path, manifest, arrays, client_state
+        except Exception as e:  # noqa: BLE001 — any damage means fall back
+            logger.warning("checkpoint %s unusable (%s); falling back to "
+                           "previous", path, e)
+    return None
+
+
+def restore(engine, ckpt_dir: str, monitor=None):
+    """Auto-resume: load the newest complete checkpoint into ``engine``,
+    resharding every leaf onto the engine's current placements (which may
+    belong to a different elastic world size than the save).
+
+    Returns ``(path, client_state)`` or ``(None, {})`` when there is
+    nothing to resume from (fresh start)."""
+    import jax
+
+    found = find_restorable(ckpt_dir)
+    if found is None:
+        logger.info("auto-resume: no usable checkpoint under %s — fresh "
+                    "start", ckpt_dir)
+        return None, {}
+    path, manifest, arrays, client_state = found
+    engine_hash = getattr(engine, "elastic_hash", "")
+    saved_hash = manifest.get("elastic_hash", "")
+    if engine_hash and saved_hash and engine_hash != saved_hash:
+        raise ResilienceError(
+            f"elastic config hash mismatch: checkpoint {path} was written "
+            f"under {saved_hash[:12]} but this engine runs {engine_hash[:12]}"
+            " — resuming would change the batch-size math mid-trajectory")
+
+    template = engine._snapshot_state()
+    named, treedef = _flatten_named(template)
+    missing = [n for n, _ in named if n not in arrays]
+    if missing:
+        raise ResilienceError(
+            f"checkpoint {path} lacks state leaves {missing[:5]} — was it "
+            "written by a different model/optimizer configuration?")
+
+    def place(name, leaf):
+        arr = arrays[name]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ResilienceError(
+                f"leaf {name!r}: checkpoint shape {arr.shape} != engine "
+                f"shape {np.shape(leaf)}")
+        arr = arr.astype(leaf.dtype)
+        if hasattr(leaf, "sharding"):
+            return jax.device_put(arr, leaf.sharding)
+        return arr
+
+    leaves = [place(name, leaf) for name, leaf in named]
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    engine._apply_restored_state(state)
+    engine.global_steps = int(manifest["step"])
+    engine.micro_steps = int(manifest["micro_steps"])
+    if engine.lr_scheduler is not None and manifest.get("lr_scheduler"):
+        engine.lr_scheduler.load_state_dict(manifest["lr_scheduler"])
+
+    if int(manifest.get("dp_world_size", engine.dp_size)) != engine.dp_size:
+        logger.info(
+            "auto-resume: elastic reshard dp %s -> %s (zero stage %s state "
+            "re-partitioned onto the new mesh)", manifest.get("dp_world_size"),
+            engine.dp_size, manifest.get("zero_stage"))
+
+    attempt = int(os.environ.get(RESUME_ATTEMPT_ENV, "0") or 0)
+    engine.recovery_count = attempt
+    mon = monitor if monitor is not None else getattr(engine, "monitor", None)
+    if mon is not None:
+        mon.add_scalar("Train/Resilience/recovery_count", attempt,
+                       engine.global_steps)
+    if getattr(engine, "ckpt_manager", None) is not None:
+        engine.ckpt_manager.metrics.add_scalar(
+            "Train/Resilience/recovery_count", attempt, engine.global_steps)
+    logger.warning("auto-resume: restored %s at global step %d (attempt %d)",
+                   path, engine.global_steps, attempt)
+    return path, client_state

@@ -1,0 +1,127 @@
+"""Deterministic fault injection.
+
+TPU pods are preemptible by design; the recovery path (resilience/checkpoint
++ supervisor) is only trustworthy if failure is *injectable* so tier-1 CPU
+tests exercise it. A :class:`FaultPlan` describes, deterministically, the
+faults a run must survive:
+
+- ``preempt_at_step``   — SIGTERM the process right after optimizer step k
+  completes (the maintenance-event preemption shape: the job dies between
+  steps, not mid-collective);
+- ``ckpt_write_errors`` — the first N checkpoint shard writes raise
+  ``OSError`` (flaky persistent-disk / GCS path), exercising the writer's
+  retry + exponential backoff;
+- ``corrupt_shard_at_step`` — after the checkpoint for step k commits, one
+  shard file's bytes are flipped (torn write / bitrot), exercising manifest
+  digest verification and the fall-back to the previous complete manifest.
+
+The plan comes from the config block (``resilience.fault_injection``) with an
+environment override (``DSTPU_FAULT_PLAN``, a JSON object merged over the
+block) so the supervisor / test driver can inject without editing configs.
+
+Faults are scoped to a restart *attempt*: injection is active only while the
+supervisor-maintained ``DSTPU_RESUME_ATTEMPT`` (default 0) is <=
+``max_attempt`` (default 0), so an injected death does not re-kill every
+resumed incarnation — the restarted job runs the same plan object but sees
+it inert, exactly like a real one-off preemption.
+"""
+
+import json
+import os
+import signal
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+FAULT_PLAN_ENV = "DSTPU_FAULT_PLAN"
+RESUME_ATTEMPT_ENV = "DSTPU_RESUME_ATTEMPT"
+
+
+@dataclass
+class FaultPlan:
+    """Deterministic fault schedule for one training incarnation."""
+
+    preempt_at_step: Optional[int] = None
+    ckpt_write_errors: int = 0
+    corrupt_shard_at_step: Optional[int] = None
+    max_attempt: int = 0
+
+    def __post_init__(self):
+        if self.ckpt_write_errors < 0:
+            raise ValueError("ckpt_write_errors must be >= 0")
+        self._io_errors_left = int(self.ckpt_write_errors)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def resolve(cls, config_block: Optional[Dict[str, Any]] = None,
+                env: Optional[Dict[str, str]] = None) -> Optional["FaultPlan"]:
+        """Config block + ``DSTPU_FAULT_PLAN`` env override -> plan (or None
+        when no fault is scheduled / a later restart attempt is running)."""
+        env = os.environ if env is None else env
+        d = dict(config_block or {})
+        override = env.get(FAULT_PLAN_ENV)
+        if override:
+            try:
+                d.update(json.loads(override))
+            except (ValueError, TypeError) as e:
+                raise ValueError(
+                    f"{FAULT_PLAN_ENV} is not a JSON object: {e}") from e
+        if not d:
+            return None
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown fault_injection keys {sorted(unknown)}; "
+                f"expected a subset of {sorted(known)}")
+        plan = cls(**{k: d[k] for k in d})
+        attempt = int(env.get(RESUME_ATTEMPT_ENV, "0") or 0)
+        if attempt > plan.max_attempt:
+            logger.info("FaultPlan inert on resume attempt %d (max_attempt="
+                        "%d): %s", attempt, plan.max_attempt, plan)
+            return None
+        return plan
+
+    # ------------------------------------------------------------------
+    def take_io_error(self) -> bool:
+        """One checkpoint shard write is about to happen; True = inject."""
+        if self._io_errors_left > 0:
+            self._io_errors_left -= 1
+            return True
+        return False
+
+    def should_preempt(self, global_step: int) -> bool:
+        return (self.preempt_at_step is not None
+                and global_step == self.preempt_at_step)
+
+    def should_corrupt(self, global_step: int) -> bool:
+        return (self.corrupt_shard_at_step is not None
+                and global_step == self.corrupt_shard_at_step)
+
+    def preempt(self, global_step: int) -> None:
+        """Deliver the injected preemption: SIGTERM to self, default
+        disposition (process death), like a real maintenance event."""
+        logger.warning("FaultPlan: injecting preemption (SIGTERM) after "
+                       "global step %d", global_step)
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def corrupt_one_shard(ckpt_path: str, manifest: Dict[str, Any]) -> str:
+    """Flip bytes in the first (name-sorted) shard of a committed
+    checkpoint — the deterministic torn-write fault. Returns the file."""
+    name = sorted(manifest["shards"])[0]
+    fname = os.path.join(ckpt_path, manifest["shards"][name]["file"])
+    with open(fname, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        # Hit the payload, not just the npy header: flip a run of bytes in
+        # the back half of the file.
+        pos = max(size // 2, min(size - 1, 128))
+        f.seek(pos)
+        chunk = f.read(min(64, size - pos))
+        f.seek(pos)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    logger.warning("FaultPlan: corrupted shard %r in %s", name, fname)
+    return fname

@@ -1,0 +1,129 @@
+"""Elastic auto-resume supervisor.
+
+The launcher-level recovery loop: run the training command; when it dies
+(real preemption, injected SIGTERM, OOM-kill, I/O crash), restart it up to
+``max_restarts`` times with exponential backoff. Each incarnation sees
+``DSTPU_RESUME_ATTEMPT`` in its environment; the training side
+(:func:`deepspeed_tpu.resilience.restore`) resumes from the newest complete
+manifest, and :class:`~.fault.FaultPlan` uses the same variable to keep
+injected faults from re-firing after the restart they were meant to cause.
+
+On restart the supervisor can also re-solve the elastic world size: given
+the job's ds-config and the chip count still available,
+:func:`deepspeed_tpu.elasticity.pick_preferred_world` selects the largest
+valid world — the restarted command reads ``DSTPU_ELASTIC_WORLD`` and
+builds its mesh/config for that world, and the resharded-load in
+``restore()`` re-partitions ZeRO state accordingly.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.resilience.fault import RESUME_ATTEMPT_ENV
+from deepspeed_tpu.utils.logging import logger
+
+ELASTIC_WORLD_ENV = "DSTPU_ELASTIC_WORLD"
+
+
+class Supervisor:
+    """Restart-on-death driver for one training command."""
+
+    def __init__(self,
+                 cmd: List[str],
+                 max_restarts: int = 3,
+                 env: Optional[Dict[str, str]] = None,
+                 backoff: float = 0.5,
+                 ckpt_dir: Optional[str] = None,
+                 available_worlds: Optional[Callable[[int], int]] = None):
+        if max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        self.cmd = list(cmd)
+        self.max_restarts = int(max_restarts)
+        self.env = dict(env or {})
+        self.backoff = float(backoff)
+        self.ckpt_dir = ckpt_dir
+        self.available_worlds = available_worlds
+        self.restarts = 0
+        self.exit_codes: List[int] = []
+        self.metrics = None
+        if ckpt_dir:
+            from deepspeed_tpu.resilience.checkpoint import METRICS_FILE
+            from deepspeed_tpu.utils.monitor import MetricsJSONL
+            os.makedirs(ckpt_dir, exist_ok=True)
+            self.metrics = MetricsJSONL(os.path.join(ckpt_dir, METRICS_FILE))
+
+    def _child_env(self, attempt: int) -> Dict[str, str]:
+        env = {**os.environ, **self.env,
+               RESUME_ATTEMPT_ENV: str(attempt)}
+        if self.available_worlds is not None:
+            env[ELASTIC_WORLD_ENV] = str(self.available_worlds(attempt))
+        return env
+
+    def run(self) -> int:
+        """Run until clean exit or restart budget exhausted; returns the
+        final exit code (0 on success)."""
+        attempt = 0
+        while True:
+            logger.info("supervisor: launching attempt %d: %s", attempt,
+                        " ".join(self.cmd))
+            proc = subprocess.Popen(self.cmd, env=self._child_env(attempt))
+            try:
+                rc = proc.wait()
+            except KeyboardInterrupt:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                raise
+            self.exit_codes.append(rc)
+            if rc == 0:
+                if self.metrics is not None:
+                    self.metrics.add_scalar(
+                        "Train/Resilience/recovery_count", self.restarts,
+                        attempt)
+                return 0
+            if self.restarts >= self.max_restarts:
+                logger.error(
+                    "supervisor: attempt %d exited rc=%d and the restart "
+                    "budget (%d) is exhausted — giving up", attempt, rc,
+                    self.max_restarts)
+                return rc
+            self.restarts += 1
+            attempt += 1
+            delay = self.backoff * (2 ** (self.restarts - 1))
+            logger.warning(
+                "supervisor: worker died rc=%d — restart %d/%d in %.2fs",
+                rc, self.restarts, self.max_restarts, delay)
+            if self.metrics is not None:
+                self.metrics.add_scalar("Train/Resilience/worker_exit_code",
+                                        rc, attempt)
+            time.sleep(delay)
+
+
+def supervise_main(argv: Optional[List[str]] = None) -> int:
+    """``python -m deepspeed_tpu.resilience.supervisor [opts] -- cmd...`` —
+    standalone auto-resume wrapper for a single-host training command."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Auto-resume supervisor: restart a training command on "
+                    "failure")
+    ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("--backoff", type=float, default=0.5)
+    ap.add_argument("--checkpoint_dir", type=str, default=None)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="training command (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        ap.error("no command given")
+    return Supervisor(cmd, max_restarts=args.max_restarts,
+                      backoff=args.backoff, ckpt_dir=args.checkpoint_dir).run()
+
+
+if __name__ == "__main__":
+    sys.exit(supervise_main())

@@ -110,25 +110,53 @@ class DeepSpeedDataLoader:
 
 
 class RepeatingLoader:
-    """Wraps an iterator to restart on StopIteration (reference :10)."""
+    """Wraps an iterator to restart on StopIteration (reference :10).
+
+    Positional and replayable: tracks (epoch, batch_in_epoch) so an
+    auto-resumed job can fast-forward the data stream to exactly where the
+    checkpoint was taken (``state_dict``/``load_state_dict`` — register
+    ``loader.state_dict`` as the engine's client-state fn and the position
+    rides every resilience checkpoint). Replay re-draws the same sampler
+    permutations, so the post-resume batch sequence is bit-identical to the
+    uninterrupted run's.
+    """
 
     def __init__(self, loader):
         self.loader = loader
         self.data_iter = iter(self.loader)
         self.epoch = 0
+        self.batch_in_epoch = 0
 
     def __iter__(self):
         return self
 
     def __next__(self):
         try:
-            return next(self.data_iter)
+            out = next(self.data_iter)
         except StopIteration:
             self.epoch += 1
+            self.batch_in_epoch = 0
             if hasattr(self.loader, "sampler") and hasattr(self.loader.sampler, "set_epoch"):
                 self.loader.sampler.set_epoch(self.epoch)
             self.data_iter = iter(self.loader)
-            return next(self.data_iter)
+            out = next(self.data_iter)
+        self.batch_in_epoch += 1
+        return out
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch, "batch_in_epoch": self.batch_in_epoch}
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Rewind to the start of the saved epoch, then replay forward —
+        going *through* ``__next__`` so epoch rollovers during the replay
+        behave identically to the original pass."""
+        self.epoch = int(sd["epoch"])
+        self.batch_in_epoch = 0
+        if hasattr(self.loader, "sampler") and hasattr(self.loader.sampler, "set_epoch"):
+            self.loader.sampler.set_epoch(self.epoch)
+        self.data_iter = iter(self.loader)
+        for _ in range(int(sd["batch_in_epoch"])):
+            next(self)
 
 
 class PrefetchLoader:

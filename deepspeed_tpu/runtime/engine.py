@@ -28,6 +28,7 @@ adapters for flax modules live in ``deepspeed_tpu.models.adapter``.
 """
 
 import collections
+import os
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -294,6 +295,32 @@ class TPUEngine:
         if config.activation_checkpointing_provided:
             from deepspeed_tpu.runtime import activation_checkpointing as _ac
             _ac.configure(deepspeed_config=config)
+        # --- resilience: preemption-aware checkpointing + fault injection ---
+        # (resilience/; docs/RESILIENCE.md). The manager writes off the step
+        # path; the fault plan deterministically injects preemption / ckpt
+        # I/O faults so recovery is testable on CPU.
+        from deepspeed_tpu.elasticity import elastic_config_hash
+        self.elastic_hash = elastic_config_hash(config.elasticity)
+        self.recovery_count = 0
+        self.ckpt_manager = None
+        self.fault_plan = None
+        self._client_state_fn = None
+        rcfg = config.resilience
+        if (rcfg.enabled or rcfg.fault_injection
+                or os.environ.get("DSTPU_FAULT_PLAN")):
+            from deepspeed_tpu.resilience import FaultPlan
+            self.fault_plan = FaultPlan.resolve(rcfg.fault_injection)
+        if rcfg.enabled:
+            from deepspeed_tpu.resilience import AsyncCheckpointManager
+            self.ckpt_manager = AsyncCheckpointManager(
+                rcfg.checkpoint.dir,
+                interval=rcfg.checkpoint.interval,
+                keep_last=rcfg.checkpoint.keep_last,
+                max_retries=rcfg.checkpoint.max_retries,
+                backoff=rcfg.checkpoint.backoff_seconds,
+                async_write=rcfg.checkpoint.async_write,
+                fault_plan=self.fault_plan,
+                monitor=self.monitor)
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size,
@@ -830,7 +857,7 @@ class TPUEngine:
                                 if a in (comp_axis, dense_axis)))
         all_manual = tuple(sorted(manual_axes))
 
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
 
         params_tree = self.state.params
         base_specs = self._base_specs
@@ -856,7 +883,8 @@ class TPUEngine:
             compute_params = precision.cast_params(params)
             rank = jax.lax.axis_index(comp_axis)
             if dense_axis is not None:
-                rank = (rank * jax.lax.axis_size(dense_axis)
+                from deepspeed_tpu.utils.jax_compat import axis_size
+                rank = (rank * axis_size(dense_axis)
                         + jax.lax.axis_index(dense_axis))
             sub = jax.random.fold_in(sub, rank)
             grads, loss = fwd_bwd(compute_params, grad_acc, sub, scale,
@@ -1127,6 +1155,7 @@ class TPUEngine:
                      ranks=[0])
         if self._last_loss is not None:
             self._post_step_hooks(self._last_loss)
+        self._resilience_step_hook()
 
     def _maybe_profile(self, fn, *args, params=None):
         """Emit the flops report at profile_step. lower+compile only
@@ -1230,6 +1259,7 @@ class TPUEngine:
             if self.config.check_numerics:
                 self._check_numerics(loss, overflow=False)
             self._post_step_hooks(loss)
+            self._resilience_step_hook()
             return loss
         lr = self._current_lr()
         self._maybe_profile(self._train_step, self.state, batches, lr,
@@ -1244,6 +1274,7 @@ class TPUEngine:
         if self.config.check_numerics:
             self._check_numerics(loss, overflow=bool(overflow))
         self._post_step_hooks(loss)
+        self._resilience_step_hook()
         return loss
 
     def _check_numerics(self, loss, overflow: bool = False) -> None:
@@ -1322,6 +1353,80 @@ class TPUEngine:
 
     def loss_scale(self) -> float:
         return float(self.state.loss_scale.scale)
+
+    # ------------------------------------------------------------------
+    # Resilience — preemption-aware async checkpointing + auto-resume
+    # (resilience/; docs/RESILIENCE.md)
+    # ------------------------------------------------------------------
+    def _resilience_step_hook(self) -> None:
+        """After every committed optimizer step: enqueue an async checkpoint
+        at the configured interval (the write happens on the manager's
+        background thread — off the step path) and deliver any injected
+        preemption. Save first, then preempt: the interrupted write is
+        exactly the torn-checkpoint case the manifest protocol handles."""
+        mgr = self.ckpt_manager
+        if mgr is not None and self.global_steps % mgr.interval == 0:
+            self.save_checkpoint_async()
+        if (self.fault_plan is not None
+                and self.fault_plan.should_preempt(self.global_steps)):
+            self.fault_plan.preempt(self.global_steps)
+
+    def register_client_state_fn(self, fn: Callable[[], Dict]) -> None:
+        """Callable whose result rides every auto-checkpoint as
+        client_state (e.g. ``loader.state_dict`` for dataloader replay)."""
+        self._client_state_fn = fn
+
+    def save_checkpoint_async(self,
+                              client_state: Optional[Dict] = None) -> None:
+        """Snapshot now, write in the background (resilience manager)."""
+        if self.ckpt_manager is None:
+            raise RuntimeError(
+                "save_checkpoint_async requires the resilience block: "
+                '{"resilience": {"enabled": true, "checkpoint": {"dir": ...}}}')
+        if client_state is None and self._client_state_fn is not None:
+            client_state = self._client_state_fn()
+        self.ckpt_manager.save(self, client_state=client_state)
+
+    def auto_resume(self):
+        """Restore from the newest complete resilience checkpoint under the
+        configured dir, resharding onto this engine's (possibly different
+        elastic) world. Returns (path, client_state) — (None, {}) means
+        fresh start."""
+        from deepspeed_tpu.resilience import restore
+
+        rcfg = self.config.resilience
+        if not (rcfg.enabled and rcfg.auto_resume):
+            return None, {}
+        return restore(self, rcfg.checkpoint.dir)
+
+    def _snapshot_state(self) -> TrainState:
+        """The state tree a resilience snapshot serialises — swapped tiers
+        are read back into host RAM first (same prologue as
+        save_checkpoint)."""
+        if self._offload_nvme():
+            master, opt = self.offloader.export_state()
+            return self.state._replace(params=master, opt_state=opt)
+        return self.state
+
+    def _apply_restored_state(self, state: TrainState) -> None:
+        """Install a restored TrainState, pushing host tiers back into the
+        offloader when one exists (mirrors load_checkpoint's epilogue)."""
+        if self._offload_nvme():
+            self.offloader.import_state(state.params, state.opt_state)
+            self._compute_params = self._offload_place(
+                jax.tree_util.tree_map(np.asarray, state.params))
+            # nvme placeholders stay; scalars (step/loss_scale/rng/...) land.
+            self.state = self.state._replace(
+                step=state.step, micro_step=state.micro_step,
+                loss_scale=state.loss_scale,
+                skipped_steps=state.skipped_steps, rng=state.rng)
+            return
+        self.state = state
+        if hasattr(self, "offloader"):
+            self.offloader.master = state.params
+            self.offloader.opt_state = state.opt_state
+            self._compute_params = self._offload_place(
+                jax.tree_util.tree_map(np.asarray, state.params))
 
     # ------------------------------------------------------------------
     # Checkpointing — delegates to runtime.checkpointing

@@ -36,8 +36,25 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from deepspeed_tpu.parallel.mesh import DATA_AXIS
 
-HOST_MEMORY_KIND = "pinned_host"
-_TO_DEVICE = jax.memory.Space.Device
+from deepspeed_tpu.utils.jax_compat import DEVICE_MEMORY_SPACE
+
+
+def _pick_host_memory_kind() -> str:
+    """pinned_host on TPU/GPU (and new XLA:CPU, which aliases it); old
+    XLA:CPU only addresses unpinned_host — placement-identical for the
+    virtual-mesh tests, so fall through rather than fail."""
+    try:
+        kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+    except Exception:
+        return "pinned_host"
+    for kind in ("pinned_host", "unpinned_host"):
+        if kind in kinds:
+            return kind
+    return "pinned_host"
+
+
+HOST_MEMORY_KIND = _pick_host_memory_kind()
+_TO_DEVICE = DEVICE_MEMORY_SPACE
 
 
 def fetch(tree: Any) -> Any:

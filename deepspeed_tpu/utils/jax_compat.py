@@ -1,0 +1,60 @@
+"""Version-compat imports for jax API moves.
+
+``shard_map`` was promoted from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace, and its partial-manual/replication-check
+kwargs were renamed (``auto``→``axis_names`` complement,
+``check_rep``→``check_vma``). The codebase is written against the new
+API; this image pins a jax that only has the experimental one, so the
+shim translates. Import ``shard_map`` from here at every call site.
+"""
+
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6)
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=None, **kw):
+        if axis_names is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+try:
+    from jax.lax import axis_size  # noqa: F401  (jax >= 0.6)
+except ImportError:
+    def axis_size(axis_name):
+        """Size of a named mesh axis inside a shard_map/collective region —
+        psum of 1 over the axis, which SPMD folds to a constant."""
+        import jax
+
+        return jax.lax.psum(1, axis_name)
+
+import jax as _jax
+
+# True when this jax ships the promoted (top-level) shard_map. Old
+# releases lower axis_index inside partial-manual regions to a
+# PartitionId HLO their SPMD partitioner rejects (and the ring-attention
+# program aborts the XLA CPU compiler outright), so version-sensitive
+# tests gate on this.
+NATIVE_SHARD_MAP = hasattr(_jax, "shard_map")
+
+try:
+    DEVICE_MEMORY_SPACE = _jax.memory.Space.Device  # jax >= 0.6
+except AttributeError:
+    from jax._src.sharding_impls import TransferToMemoryKind
+    DEVICE_MEMORY_SPACE = TransferToMemoryKind("device")
+
+def distributed_is_initialized() -> bool:
+    """jax.distributed.is_initialized, which old jax doesn't export —
+    there, the private global client being set is the same signal."""
+    if hasattr(_jax.distributed, "is_initialized"):
+        return _jax.distributed.is_initialized()
+    from jax._src import distributed
+    return distributed.global_state.client is not None
+
+
+__all__ = ["shard_map", "axis_size", "DEVICE_MEMORY_SPACE",
+           "NATIVE_SHARD_MAP", "distributed_is_initialized"]

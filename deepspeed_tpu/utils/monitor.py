@@ -6,11 +6,62 @@ at every logging boundary). Uses torch's SummaryWriter when available (torch
 is CPU-only in this image, which is all a writer needs); falls back to a
 JSONL event log with the same (tag, value, step) schema so monitoring never
 silently disappears.
+
+:class:`MetricsJSONL` is that fallback schema as a standalone append-only
+writer — the resilience subsystem uses it to record checkpoint write
+latency, snapshot cost, and recovery counters next to the checkpoints
+themselves, so the scalars survive even when tensorboard is disabled (the
+auto-resume probe and tests read them back).
 """
 
 import json
 import os
+import threading
 from typing import Optional
+
+
+class MetricsJSONL:
+    """Append-only ``{tag, value, step, [extra]}`` JSONL scalar log.
+
+    Thread-safe (the async checkpoint writer emits from its background
+    thread while the engine emits from the step loop) and line-buffered so
+    a preemption mid-run loses at most the current line.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "a", buffering=1)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(json.dumps(
+                {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+
+    def read(self, tag: Optional[str] = None):
+        """All recorded rows (optionally one tag) — test/probe convenience."""
+        rows = []
+        if not os.path.exists(self.path):
+            return rows
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                row = json.loads(line)
+                if tag is None or row.get("tag") == tag:
+                    rows.append(row)
+        return rows
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
 
 
 class TensorboardMonitor:
@@ -25,15 +76,14 @@ class TensorboardMonitor:
             from torch.utils.tensorboard import SummaryWriter
             self._writer = SummaryWriter(log_dir=self.log_dir)
         except Exception:
-            self._jsonl = open(os.path.join(self.log_dir, "scalars.jsonl"),
-                               "a", buffering=1)
+            self._jsonl = MetricsJSONL(
+                os.path.join(self.log_dir, "scalars.jsonl"))
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
         if self._writer is not None:
             self._writer.add_scalar(tag, float(value), int(step))
         else:
-            self._jsonl.write(json.dumps(
-                {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+            self._jsonl.add_scalar(tag, value, step)
 
     def flush(self) -> None:
         if self._writer is not None:

@@ -1,0 +1,521 @@
+"""Resilience subsystem (resilience/, docs/RESILIENCE.md): async
+double-buffered checkpointing with manifest digests, deterministic fault
+injection, and the supervisor auto-resume contract — crash at step k,
+restart, resume, and the loss trajectory is bit-identical to an
+uninterrupted run."""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+import jax
+
+from deepspeed_tpu import initialize
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.resilience import (AsyncCheckpointManager, FaultPlan,
+                                      ResilienceError, Supervisor,
+                                      find_restorable, list_checkpoints,
+                                      restore)
+from deepspeed_tpu.resilience.checkpoint import MANIFEST_FILE
+from deepspeed_tpu.resilience.fault import (FAULT_PLAN_ENV,
+                                            RESUME_ATTEMPT_ENV,
+                                            corrupt_one_shard)
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+from simple_model import mlp_params, mlp_loss_fn, random_batches
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _make_engine(ckpt_dir, dp=8, micro_bs=2, zero_stage=1, interval=1,
+                 keep_last=3, fault_injection=None, async_write=True,
+                 extra=None):
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": zero_stage},
+        "resilience": {
+            "enabled": True,
+            "checkpoint": {"dir": str(ckpt_dir), "interval": interval,
+                           "keep_last": keep_last, "async": async_write,
+                           "backoff_seconds": 0.01},
+            "fault_injection": fault_injection or {},
+        },
+    }
+    config.update(extra or {})
+    engine, _, _, _ = initialize(
+        loss_fn=mlp_loss_fn, params=mlp_params(), config=config,
+        mesh=build_mesh(data=dp, devices=jax.devices()[:dp]), rng_seed=0)
+    return engine
+
+
+def _batch_stream(n, seed=7, batch_size=16):
+    rng = np.random.default_rng(seed)
+    return [random_batches(rng, 1, batch_size=batch_size) for _ in range(n)]
+
+
+def _params_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(jax.device_get(a)),
+                    jax.tree_util.tree_leaves(jax.device_get(b))):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# Async manager: commit protocol, manifest, GC, double buffer, retries
+# ---------------------------------------------------------------------------
+
+def test_async_commit_manifest_and_roundtrip(tmp_path):
+    e1 = _make_engine(tmp_path)
+    for b in _batch_stream(3):
+        e1.train_batch(b)
+    e1.ckpt_manager.wait()
+    ckpts = list_checkpoints(str(tmp_path))
+    assert [s for s, _ in ckpts] == [1, 2, 3]
+    manifest = json.load(open(os.path.join(ckpts[-1][1], MANIFEST_FILE)))
+    assert manifest["step"] == 3
+    assert manifest["dp_world_size"] == 8
+    assert manifest["zero_stage"] == 1
+    assert manifest["shards"]  # every leaf carries file + sha256
+    for rec in manifest["shards"].values():
+        assert set(rec) >= {"file", "sha256", "shape", "dtype"}
+
+    e2 = _make_engine(tmp_path)
+    path, _ = e2.auto_resume()
+    assert path == ckpts[-1][1]
+    assert e2.global_steps == 3
+    _params_equal(e1.state.params, e2.state.params)
+    _params_equal(e1.state.opt_state.exp_avg, e2.state.opt_state.exp_avg)
+    e1.ckpt_manager.close()
+    e2.ckpt_manager.close()
+
+
+def test_bit_identical_continuation_after_resume(tmp_path):
+    stream = _batch_stream(6)
+    e1 = _make_engine(tmp_path)
+    for b in stream[:3]:
+        e1.train_batch(b)
+    e1.ckpt_manager.wait()
+    e2 = _make_engine(tmp_path)
+    e2.auto_resume()
+    cont1 = [repr(float(e1.train_batch(b))) for b in stream[3:]]
+    cont2 = [repr(float(e2.train_batch(b))) for b in stream[3:]]
+    assert cont1 == cont2  # bit-identical, not just allclose
+    e1.ckpt_manager.close()
+    e2.ckpt_manager.close()
+
+
+def test_gc_keeps_last_n(tmp_path):
+    e = _make_engine(tmp_path, keep_last=2)
+    for b in _batch_stream(5):
+        e.train_batch(b)
+        e.ckpt_manager.wait()   # drain so every step commits (no drops)
+    assert [s for s, _ in list_checkpoints(str(tmp_path))] == [4, 5]
+    e.ckpt_manager.close()
+
+
+def test_double_buffer_latest_wins(tmp_path):
+    """While a write is held, newer snapshots replace the pending one —
+    slow disk back-pressures to skipped intermediates, never a stall."""
+    e = _make_engine(tmp_path)
+    mgr = e.ckpt_manager
+    mgr._unpaused.clear()       # hold the writer
+    for b in _batch_stream(3):
+        e.train_batch(b)        # 3 saves enqueued while writer is held
+    assert mgr.stats["dropped"] >= 1
+    mgr._unpaused.set()
+    mgr.wait()
+    steps = [s for s, _ in list_checkpoints(str(tmp_path))]
+    assert steps[-1] == 3       # the newest snapshot always lands
+    assert 2 not in steps       # the superseded intermediate was dropped
+    mgr.close()
+
+
+def test_injected_io_error_retries_then_commits(tmp_path):
+    e = _make_engine(tmp_path, fault_injection={"ckpt_write_errors": 2})
+    assert e.fault_plan is not None
+    e.train_batch(_batch_stream(1)[0])
+    e.ckpt_manager.wait()
+    assert e.ckpt_manager.stats["retries"] == 2
+    assert e.ckpt_manager.stats["saved"] == 1
+    assert e.ckpt_manager.stats["failed"] == 0
+    assert [s for s, _ in list_checkpoints(str(tmp_path))] == [1]
+    e.ckpt_manager.close()
+
+
+def test_write_failure_never_kills_training(tmp_path):
+    e = _make_engine(tmp_path, fault_injection={"ckpt_write_errors": 99})
+    e.ckpt_manager.max_retries = 1
+    losses = [float(e.train_batch(b)) for b in _batch_stream(2)]
+    e.ckpt_manager.wait()
+    assert all(np.isfinite(losses))          # training survived
+    assert e.ckpt_manager.stats["failed"] == 2
+    assert list_checkpoints(str(tmp_path)) == []
+    assert isinstance(e.ckpt_manager.last_error, OSError)
+    e.ckpt_manager.close()
+
+
+# ---------------------------------------------------------------------------
+# Corruption: digest verification and fallback
+# ---------------------------------------------------------------------------
+
+def test_corrupt_shard_falls_back_to_previous(tmp_path):
+    e1 = _make_engine(tmp_path)
+    stream = _batch_stream(3)
+    for b in stream[:2]:
+        e1.train_batch(b)
+    e1.ckpt_manager.wait()
+    params_at_1 = None
+    ckpts = list_checkpoints(str(tmp_path))
+    assert [s for s, _ in ckpts] == [1, 2]
+    # Torn write / bitrot on the newest checkpoint:
+    manifest = json.load(open(os.path.join(ckpts[1][1], MANIFEST_FILE)))
+    corrupt_one_shard(ckpts[1][1], manifest)
+
+    path, found_manifest, _, _ = find_restorable(str(tmp_path))
+    assert path == ckpts[0][1]              # fell back past the torn one
+    assert found_manifest["step"] == 1
+
+    e2 = _make_engine(tmp_path)
+    rpath, _ = e2.auto_resume()
+    assert rpath == ckpts[0][1]
+    assert e2.global_steps == 1
+    e1.ckpt_manager.close()
+    e2.ckpt_manager.close()
+
+
+def test_corrupt_injection_at_step(tmp_path):
+    """FaultPlan.corrupt_shard_at_step corrupts after commit — the loader
+    must skip it by digest."""
+    e = _make_engine(tmp_path,
+                     fault_injection={"corrupt_shard_at_step": 2})
+    for b in _batch_stream(2):
+        e.train_batch(b)
+    e.ckpt_manager.wait()
+    path, manifest, _, _ = find_restorable(str(tmp_path))
+    assert manifest["step"] == 1
+    e.ckpt_manager.close()
+
+
+def test_tmp_dirs_and_junk_never_considered(tmp_path):
+    e = _make_engine(tmp_path)
+    e.train_batch(_batch_stream(1)[0])
+    e.ckpt_manager.wait()
+    os.makedirs(tmp_path / ".tmp-step_00000009")   # death mid-write residue
+    os.makedirs(tmp_path / "step_notanumber")
+    assert [s for s, _ in list_checkpoints(str(tmp_path))] == [1]
+    e.ckpt_manager.close()
+
+
+def test_no_checkpoint_means_fresh_start(tmp_path):
+    e = _make_engine(tmp_path)
+    path, client = e.auto_resume()
+    assert path is None and client == {}
+    assert e.global_steps == 0
+    e.ckpt_manager.close()
+
+
+# ---------------------------------------------------------------------------
+# Elastic resume: different world size, reshard, hash pinning
+# ---------------------------------------------------------------------------
+
+def test_elastic_resume_reshards_zero1(tmp_path):
+    """Save under dp=8, resume under dp=4 with the same global batch: the
+    gathered shards are device_put against the new mesh's shardings (the
+    reshard), and the trajectory matches the uninterrupted dp=8 run."""
+    stream = _batch_stream(5)
+    e1 = _make_engine(tmp_path, dp=8, micro_bs=2)   # global batch 16
+    for b in stream[:3]:
+        e1.train_batch(b)
+    e1.ckpt_manager.wait()
+
+    e2 = _make_engine(tmp_path, dp=4, micro_bs=4)   # same global batch 16
+    path, _ = e2.auto_resume()
+    assert path is not None
+    assert e2.global_steps == 3
+    _params_equal(e1.state.params, e2.state.params)
+    # ZeRO-1 optimizer state landed sharded over the NEW data axis:
+    leaf = jax.tree_util.tree_leaves(e2.state.opt_state.exp_avg)[0]
+    assert leaf.sharding.mesh.shape["data"] == 4
+
+    cont1 = [float(e1.train_batch(b)) for b in stream[3:]]
+    cont2 = [float(e2.train_batch(b)) for b in stream[3:]]
+    # Same math, different dp reduction grouping — exact up to fp
+    # association, so tight allclose rather than bit-equal:
+    np.testing.assert_allclose(cont1, cont2, rtol=1e-5, atol=1e-6)
+    e1.ckpt_manager.close()
+    e2.ckpt_manager.close()
+
+
+def test_elastic_hash_mismatch_refuses_resume(tmp_path):
+    e1 = _make_engine(tmp_path)
+    e1.elastic_hash = "aaaa"     # pretend an elastic ladder pinned the run
+    e1.train_batch(_batch_stream(1)[0])
+    e1.ckpt_manager.wait()
+    e2 = _make_engine(tmp_path)
+    e2.elastic_hash = "bbbb"     # resumed under a different batch math
+    with pytest.raises(ResilienceError, match="elastic config hash"):
+        restore(e2, str(tmp_path))
+    e1.ckpt_manager.close()
+    e2.ckpt_manager.close()
+
+
+def test_pick_preferred_world():
+    from deepspeed_tpu.elasticity import (ElasticityIncompatibleWorldSize,
+                                          compute_elastic_config,
+                                          pick_preferred_world)
+    ds_config = {"elasticity": {"enabled": True,
+                                "max_train_batch_size": 10000,
+                                "micro_batch_sizes": [8, 12, 16, 17],
+                                "min_chips": 32, "max_chips": 1500,
+                                "version": 0.1}}
+    _, valid = compute_elastic_config(ds_config, "0.3.1")
+    w = pick_preferred_world(ds_config, available_chips=max(valid))
+    assert w == max(valid)
+    smaller = pick_preferred_world(ds_config, available_chips=max(valid) - 1)
+    assert smaller in valid and smaller < max(valid)
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        pick_preferred_world(ds_config, available_chips=min(valid) - 1)
+
+
+def test_elastic_config_hash_stability():
+    from deepspeed_tpu.elasticity import elastic_config_hash
+    block = {"enabled": True, "max_train_batch_size": 1024,
+             "micro_batch_sizes": [4, 8], "min_chips": 8, "max_chips": 64}
+    h1 = elastic_config_hash(dict(block))
+    h2 = elastic_config_hash({**block,
+                              "micro_batch_sizes": [8, 4]})  # order-free
+    assert h1 == h2 and h1
+    assert elastic_config_hash({**block, "max_train_batch_size": 512}) != h1
+    assert elastic_config_hash({"enabled": False}) == ""
+    assert elastic_config_hash(None) == ""
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan resolution and scoping
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_env_override_and_unknown_keys(monkeypatch):
+    monkeypatch.setenv(FAULT_PLAN_ENV, '{"preempt_at_step": 4}')
+    plan = FaultPlan.resolve({"ckpt_write_errors": 1})
+    assert plan.preempt_at_step == 4 and plan.ckpt_write_errors == 1
+    monkeypatch.setenv(FAULT_PLAN_ENV, '{"not_a_fault": 1}')
+    with pytest.raises(ValueError, match="unknown fault_injection keys"):
+        FaultPlan.resolve({})
+    monkeypatch.setenv(FAULT_PLAN_ENV, 'not json')
+    with pytest.raises(ValueError, match="not a JSON object"):
+        FaultPlan.resolve({})
+    monkeypatch.delenv(FAULT_PLAN_ENV)
+    assert FaultPlan.resolve({}) is None
+    assert FaultPlan.resolve(None) is None
+
+
+def test_fault_plan_inert_after_its_restart(monkeypatch):
+    """The injected death must not re-fire in the incarnation it caused."""
+    block = {"preempt_at_step": 2}
+    assert FaultPlan.resolve(block).should_preempt(2)
+    monkeypatch.setenv(RESUME_ATTEMPT_ENV, "1")
+    assert FaultPlan.resolve(block) is None
+    assert FaultPlan.resolve({**block, "max_attempt": 1}) is not None
+
+
+def test_config_validation():
+    from deepspeed_tpu.config.config import ConfigError, DeepSpeedTPUConfig
+    base = {"train_micro_batch_size_per_gpu": 1}
+    with pytest.raises(ConfigError, match="checkpoint.dir"):
+        DeepSpeedTPUConfig({**base, "resilience": {"enabled": True}})
+    with pytest.raises(ConfigError, match="interval"):
+        DeepSpeedTPUConfig({**base, "resilience": {
+            "enabled": True,
+            "checkpoint": {"dir": "/tmp/x", "interval": 0}}})
+    cfg = DeepSpeedTPUConfig({**base, "resilience": {
+        "enabled": True, "checkpoint": {"dir": "/tmp/x", "interval": 5}}})
+    assert cfg.resilience.checkpoint.interval == 5
+    assert DeepSpeedTPUConfig(base).resilience.enabled is False
+
+
+# ---------------------------------------------------------------------------
+# Dataloader replay
+# ---------------------------------------------------------------------------
+
+class _CountingSampler:
+    def __init__(self):
+        self.epoch = 0
+
+    def set_epoch(self, e):
+        self.epoch = e
+
+
+class _ListLoader:
+    """Epoch-aware toy loader: item values depend on the sampler epoch the
+    way a shuffling sampler's permutation does."""
+
+    def __init__(self, n):
+        self.n = n
+        self.sampler = _CountingSampler()
+
+    def __iter__(self):
+        base = self.sampler.epoch * 100
+        return iter(range(base, base + self.n))
+
+
+def test_repeating_loader_replay_is_exact():
+    src = RepeatingLoader(_ListLoader(4))
+    consumed = [next(src) for _ in range(10)]   # crosses 2 epoch boundaries
+    sd = src.state_dict()
+    assert sd == {"epoch": 2, "batch_in_epoch": 2}
+
+    resumed = RepeatingLoader(_ListLoader(4))
+    resumed.load_state_dict(sd)
+    tail = [next(resumed) for _ in range(5)]
+    cont = [next(src) for _ in range(5)]
+    assert tail == cont                          # identical post-resume stream
+    # and the replayed prefix saw the same epochs the original did:
+    assert resumed.state_dict() == src.state_dict()
+
+
+def test_client_state_rides_checkpoints(tmp_path):
+    e = _make_engine(tmp_path)
+    loader = RepeatingLoader(_ListLoader(4))
+    e.register_client_state_fn(lambda: {"loader": loader.state_dict()})
+    for _ in range(3):
+        next(loader)
+        e.train_batch(_batch_stream(1)[0])
+    e.ckpt_manager.wait()
+    _, _, _, client = find_restorable(str(tmp_path))
+    assert client["loader"] == {"epoch": 0, "batch_in_epoch": 3}
+    e.ckpt_manager.close()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+def test_supervisor_restarts_until_success(tmp_path):
+    marker = tmp_path / "died_once"
+    script = textwrap.dedent(f"""
+        import os, sys
+        marker = {str(marker)!r}
+        attempt = int(os.environ.get({RESUME_ATTEMPT_ENV!r}, "0"))
+        with open({str(tmp_path / "attempts.log")!r}, "a") as f:
+            f.write(str(attempt) + "\\n")
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(17)   # first incarnation dies
+        sys.exit(0)
+    """)
+    sup = Supervisor([sys.executable, "-c", script], max_restarts=3,
+                     backoff=0.01)
+    assert sup.run() == 0
+    assert sup.restarts == 1
+    assert sup.exit_codes == [17, 0]
+    attempts = open(tmp_path / "attempts.log").read().split()
+    assert attempts == ["0", "1"]   # each incarnation saw its attempt index
+
+
+def test_supervisor_gives_up_after_budget():
+    sup = Supervisor([sys.executable, "-c", "import sys; sys.exit(3)"],
+                     max_restarts=2, backoff=0.01)
+    assert sup.run() == 3
+    assert sup.exit_codes == [3, 3, 3]
+
+
+def test_supervisor_elastic_world_env(tmp_path):
+    out = tmp_path / "world.log"
+    script = (f"import os; open({str(out)!r}, 'a').write("
+              f"os.environ.get('DSTPU_ELASTIC_WORLD', '?') + '\\n')")
+    sup = Supervisor([sys.executable, "-c", script], max_restarts=0,
+                     available_worlds=lambda attempt: 8 // (attempt + 1))
+    assert sup.run() == 0
+    assert open(out).read().split() == ["8"]
+
+
+# ---------------------------------------------------------------------------
+# End to end: SIGTERM at step k -> auto-resume -> bit-identical trajectory
+# ---------------------------------------------------------------------------
+
+_TRAIN_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, sys.argv[4])
+    import numpy as np
+    from deepspeed_tpu import initialize
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from simple_model import mlp_params, mlp_loss_fn, random_batches
+
+    ckpt_dir, total_steps, out = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    engine, _, _, _ = initialize(
+        loss_fn=mlp_loss_fn, params=mlp_params(),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 1000,
+            "resilience": {"enabled": True,
+                           "checkpoint": {"dir": ckpt_dir, "interval": 1,
+                                          "backoff_seconds": 0.01}},
+        },
+        mesh=build_mesh(data=8), rng_seed=0)
+    engine.auto_resume()
+    # Deterministic stream indexed by global step: the resumed incarnation
+    # regenerates the SAME batches the dead one saw.
+    rng = np.random.default_rng(7)
+    stream = [random_batches(rng, 1, batch_size=16)
+              for _ in range(total_steps)]
+    with open(out, "a", buffering=1) as f:
+        for i in range(engine.global_steps, total_steps):
+            loss = float(engine.train_batch(stream[i]))
+            f.write(json.dumps({"step": i + 1, "loss": repr(loss)}) + "\\n")
+    engine.ckpt_manager.close()
+""")
+
+
+def _trajectory(path):
+    """step -> loss repr, last write wins (re-executed steps supersede)."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            row = json.loads(line)
+            out[row["step"]] = row["loss"]
+    return out
+
+
+@pytest.mark.parametrize("preempt_step", [3])
+def test_sigterm_resume_bit_identical_trajectory(tmp_path, preempt_step):
+    """The acceptance gate: SIGTERM injected after step k, the supervisor
+    restarts the job, it auto-resumes from the newest complete manifest,
+    and every step's loss — including k..k+3 — is bit-identical to an
+    uninterrupted run of the same config/seed."""
+    total = preempt_step + 4
+    env = {"JAX_PLATFORMS": "cpu"}
+
+    faulted = tmp_path / "faulted"
+    faulted.mkdir()
+    sup = Supervisor(
+        [sys.executable, "-c", _TRAIN_SCRIPT, str(faulted / "ckpt"),
+         str(total), str(faulted / "losses.jsonl"), TESTS_DIR],
+        max_restarts=2, backoff=0.01,
+        env={**env,
+             FAULT_PLAN_ENV: json.dumps({"preempt_at_step": preempt_step})})
+    assert sup.run() == 0
+    assert sup.restarts == 1          # died exactly once, at step k
+    assert sup.exit_codes[0] != 0 and sup.exit_codes[-1] == 0
+
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    rc = subprocess.run(
+        [sys.executable, "-c", _TRAIN_SCRIPT, str(clean / "ckpt"),
+         str(total), str(clean / "losses.jsonl"), TESTS_DIR],
+        env={**os.environ, **env}).returncode
+    assert rc == 0
+
+    got = _trajectory(faulted / "losses.jsonl")
+    want = _trajectory(clean / "losses.jsonl")
+    assert set(got) == set(range(1, total + 1))
+    assert got == want   # bit-identical: compared as float reprs
