@@ -326,6 +326,18 @@ def main():
         sys.exit(1)
     on_tpu = platform == "tpu"
     peak = PEAK_TFLOPS.get(getattr(dev, "device_kind", ""), 197.0)
+    # Environment block: the conditions the rows were measured under, so
+    # numbers stay comparable across PRs. telemetry is explicitly "off" —
+    # none of the bench configs enable the telemetry block, so no sync'd
+    # spans or per-step gauges perturb the timed windows; a future PR that
+    # benches with telemetry on must say so here.
+    result["environment"] = {
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "devices": len(jax.devices()),
+        "jax": jax.__version__,
+        "telemetry": "off",
+    }
 
     if on_tpu:
         steps, warmup = 10, 2

@@ -256,6 +256,84 @@ class AIOConfig:
 
 
 @dataclass
+class TelemetryTraceConfig:
+    """Step tracer knobs (telemetry/tracer.py)."""
+
+    enabled: bool = C.TELEMETRY_TRACE_ENABLED_DEFAULT
+    file: str = C.TELEMETRY_TRACE_FILE_DEFAULT
+    sync_spans: bool = C.TELEMETRY_TRACE_SYNC_SPANS_DEFAULT
+    jax_profiler_dir: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryTraceConfig":
+        d = d or {}
+        return cls(
+            enabled=bool(_get(d, C.TELEMETRY_TRACE_ENABLED,
+                              C.TELEMETRY_TRACE_ENABLED_DEFAULT)),
+            file=str(_get(d, C.TELEMETRY_TRACE_FILE,
+                          C.TELEMETRY_TRACE_FILE_DEFAULT)),
+            sync_spans=bool(_get(d, C.TELEMETRY_TRACE_SYNC_SPANS,
+                                 C.TELEMETRY_TRACE_SYNC_SPANS_DEFAULT)),
+            jax_profiler_dir=d.get(C.TELEMETRY_TRACE_JAX_PROFILER_DIR),
+        )
+
+
+@dataclass
+class TelemetryMetricsConfig:
+    """Metrics registry sinks (telemetry/registry.py)."""
+
+    sinks: tuple = C.TELEMETRY_METRICS_SINKS_DEFAULT
+    file: str = C.TELEMETRY_METRICS_FILE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryMetricsConfig":
+        d = d or {}
+        sinks = tuple(_get(d, C.TELEMETRY_METRICS_SINKS,
+                           C.TELEMETRY_METRICS_SINKS_DEFAULT))
+        for s in sinks:
+            if s not in C.TELEMETRY_METRICS_VALID_SINKS:
+                raise ConfigError(
+                    f"telemetry.metrics.sinks: unknown sink {s!r} (valid: "
+                    f"{list(C.TELEMETRY_METRICS_VALID_SINKS)})")
+        return cls(sinks=sinks,
+                   file=str(_get(d, C.TELEMETRY_METRICS_FILE,
+                                 C.TELEMETRY_METRICS_FILE_DEFAULT)))
+
+
+@dataclass
+class TelemetryConfig:
+    """Unified observability (telemetry/; docs/OBSERVABILITY.md): metrics
+    registry + Chrome-trace step tracer + recompilation detector. Disabled
+    (the default) every hook is a no-op and the step path performs zero
+    telemetry-originated device syncs."""
+
+    enabled: bool = False
+    dir: str = C.TELEMETRY_DIR_DEFAULT
+    trace: TelemetryTraceConfig = field(default_factory=TelemetryTraceConfig)
+    metrics: TelemetryMetricsConfig = field(
+        default_factory=TelemetryMetricsConfig)
+    recompile_detection: bool = C.TELEMETRY_RECOMPILE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.TELEMETRY_ENABLED, False)),
+            dir=str(_get(d, C.TELEMETRY_DIR, C.TELEMETRY_DIR_DEFAULT)),
+            trace=TelemetryTraceConfig.from_dict(d.get(C.TELEMETRY_TRACE)),
+            metrics=TelemetryMetricsConfig.from_dict(
+                d.get(C.TELEMETRY_METRICS)),
+            recompile_detection=bool(_get(d, C.TELEMETRY_RECOMPILE,
+                                          C.TELEMETRY_RECOMPILE_DEFAULT)),
+        )
+        if cfg.enabled and not cfg.dir:
+            raise ConfigError(
+                "telemetry.enabled requires telemetry.dir (where the trace "
+                "file and metrics JSONL land)")
+        return cfg
+
+
+@dataclass
 class TensorboardConfig:
     enabled: bool = False
     output_path: str = ""
@@ -366,6 +444,7 @@ class DeepSpeedTPUConfig:
         self.pld = PLDConfig.from_dict(d.get(C.PROGRESSIVE_LAYER_DROP))
         self.aio = AIOConfig.from_dict(d.get(C.AIO))
         self.tensorboard = TensorboardConfig.from_dict(d.get(C.TENSORBOARD))
+        self.telemetry = TelemetryConfig.from_dict(d.get(C.TELEMETRY))
         self.resilience = ResilienceConfig.from_dict(d.get(C.RESILIENCE))
         self.sparse_attention = d.get(C.SPARSE_ATTENTION)
         self.pipeline = dict(d.get(C.PIPELINE, {}))

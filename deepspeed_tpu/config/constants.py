@@ -99,6 +99,31 @@ RESILIENCE_AUTO_RESUME_DEFAULT = True
 RESILIENCE_FAULT_INJECTION = "fault_injection"
 
 #############################################
+# Telemetry (TPU-native block, no reference analogue: unified metrics
+# registry + step tracer + recompilation detector, telemetry/)
+#############################################
+TELEMETRY = "telemetry"
+TELEMETRY_ENABLED = "enabled"
+TELEMETRY_DIR = "dir"
+TELEMETRY_DIR_DEFAULT = "telemetry"
+TELEMETRY_TRACE = "trace"
+TELEMETRY_TRACE_ENABLED = "enabled"
+TELEMETRY_TRACE_ENABLED_DEFAULT = True
+TELEMETRY_TRACE_FILE = "file"
+TELEMETRY_TRACE_FILE_DEFAULT = "trace.json"
+TELEMETRY_TRACE_SYNC_SPANS = "sync_spans"
+TELEMETRY_TRACE_SYNC_SPANS_DEFAULT = True
+TELEMETRY_TRACE_JAX_PROFILER_DIR = "jax_profiler_dir"
+TELEMETRY_METRICS = "metrics"
+TELEMETRY_METRICS_SINKS = "sinks"
+TELEMETRY_METRICS_SINKS_DEFAULT = ("jsonl",)
+TELEMETRY_METRICS_VALID_SINKS = ("jsonl", "tensorboard", "memory")
+TELEMETRY_METRICS_FILE = "file"
+TELEMETRY_METRICS_FILE_DEFAULT = "metrics.jsonl"
+TELEMETRY_RECOMPILE = "recompile_detection"
+TELEMETRY_RECOMPILE_DEFAULT = True
+
+#############################################
 # Logging / misc
 #############################################
 STEPS_PER_PRINT = "steps_per_print"

@@ -44,13 +44,15 @@ class InferenceConfig:
     def __init__(self, mp_size: int = 1, dtype: Any = None,
                  quantize: bool = False, quantize_groups: int = 1,
                  replace_with_kernel_inject: bool = True,
-                 max_tokens: Optional[int] = None, **extra):
+                 max_tokens: Optional[int] = None,
+                 recompile_detection: bool = True, **extra):
         self.mp_size = int(mp_size)
         self.dtype = dtype if dtype is not None else jnp.bfloat16
         self.quantize = bool(quantize)
         self.quantize_groups = int(quantize_groups)
         self.replace_with_kernel_inject = bool(replace_with_kernel_inject)
         self.max_tokens = max_tokens
+        self.recompile_detection = bool(recompile_detection)
         self.extra = extra
 
 
@@ -159,6 +161,12 @@ class InferenceEngine:
         self._forward_jit = None
         self._generate_jit: Dict = {}
         self._generate_calls = 0
+        # Serving-side retrace alarm (telemetry/recompile.py): a ragged
+        # prompt length or dtype drift recompiles prefill+decode per
+        # request — seconds of silent tail latency the detector names.
+        from deepspeed_tpu.telemetry import RecompileDetector
+        self.recompile_detector = RecompileDetector(
+            enabled=cfg.recompile_detection)
 
     # ------------------------------------------------------------------
     def _default_rules(self):
@@ -200,6 +208,7 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     def forward(self, batch, **kwargs):
         """Jitted deterministic forward; returns the module's output dict."""
+        self.recompile_detector.check("inference.forward", batch)
         if self._forward_jit is None:
             def fwd(params, batch):
                 p = self._materialized(params)
@@ -275,6 +284,11 @@ class InferenceEngine:
             seed = self._generate_calls
             if temperature > 0.0:
                 self._generate_calls += 1
+        self.recompile_detector.check(
+            "inference.generate", ids, mask,
+            {"static": f"max_new_tokens={int(max_new_tokens)},"
+                       f"temperature={float(temperature)},"
+                       f"top_k={int(top_k)}"})
         key = (b, t0, int(max_new_tokens), float(temperature), int(top_k),
                mask is not None)
         if key not in self._generate_jit:

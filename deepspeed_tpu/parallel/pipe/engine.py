@@ -292,7 +292,25 @@ class PipelineEngine(TPUEngine):
         """One pipelined optimizer step over GAS microbatches. ``batches``
         leaves carry a leading microbatch dim == gradient_accumulation_steps
         (use ``split_batch`` to build them from a flat batch)."""
-        loss = super().train_batch(batches)
+        tel = self.telemetry
+        with tel.span("pipe_step", step=self.global_steps,
+                      stages=self.num_stages,
+                      micro_batches=self.micro_batches) as sp:
+            loss = super().train_batch(batches)
+        if tel.enabled and self.num_stages > 1:
+            # Per-stage bubble: in a GPipe/1F1B schedule every stage idles
+            # (S-1) microbatch slots of the (M + S - 1)-slot step, so the
+            # analytic bubble fraction is uniform across stages; with
+            # sync'd spans the pipe_step duration is the real step wall
+            # time and frac * duration is each stage's idle time.
+            frac = (self.num_stages - 1) / (self.micro_batches
+                                            + self.num_stages - 1)
+            reg = tel.registry
+            reg.gauge("pipe/bubble_fraction").set(frac,
+                                                  step=self.global_steps)
+            if sp.duration:
+                reg.gauge("pipe/bubble_time_sec").set(
+                    sp.duration * frac, step=self.global_steps)
         if self.global_steps % self.steps_per_print == 0:
             log_dist(f"step={self.global_steps} loss={float(loss):.4f}",
                      ranks=[0])

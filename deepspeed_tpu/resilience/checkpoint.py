@@ -155,7 +155,8 @@ class AsyncCheckpointManager:
                  backoff: float = 0.05,
                  async_write: bool = True,
                  fault_plan: Optional[FaultPlan] = None,
-                 monitor=None):
+                 monitor=None,
+                 telemetry=None):
         if interval < 1:
             raise ValueError("checkpoint interval must be >= 1")
         if keep_last < 1:
@@ -168,6 +169,7 @@ class AsyncCheckpointManager:
         self.async_write = bool(async_write)
         self.fault_plan = fault_plan
         self.monitor = monitor
+        self.telemetry = telemetry
         self.stats = {"saved": 0, "dropped": 0, "retries": 0, "failed": 0}
         self.last_error: Optional[BaseException] = None
         os.makedirs(ckpt_dir, exist_ok=True)
@@ -187,6 +189,13 @@ class AsyncCheckpointManager:
             self._thread = threading.Thread(
                 target=self._writer_loop, name="ckpt-writer", daemon=True)
             self._thread.start()
+            # The writer is a daemon thread (it must never block a SIGTERM
+            # teardown), so a CLEAN interpreter exit would otherwise kill
+            # it mid-write and silently lose the newest auto-saved
+            # checkpoint. Drain pending work at exit; bounded because
+            # retries are bounded (max_retries × backoff).
+            import atexit
+            atexit.register(self._drain_at_exit)
 
     # ------------------------------------------------------------------
     def save(self, engine, client_state: Optional[Dict] = None,
@@ -197,7 +206,8 @@ class AsyncCheckpointManager:
         ``last_error`` plus the log (checkpointing must not kill the run
         it exists to protect)."""
         t0 = time.monotonic()
-        snap = snapshot_engine(engine, client_state=client_state)
+        with self._span("ckpt_snapshot", step=int(engine.global_steps)):
+            snap = snapshot_engine(engine, client_state=client_state)
         snap.meta["snapshot_sec"] = round(time.monotonic() - t0, 6)
         if not self.async_write:
             self._write_with_retries(snap)
@@ -208,6 +218,7 @@ class AsyncCheckpointManager:
             if self._pending is not None:
                 # Double buffer: one writing + one pending; latest wins.
                 self.stats["dropped"] += 1
+                self._counter("ckpt/dropped", step=snap.step)
                 logger.warning(
                     "async checkpoint backlog: dropping pending step %d "
                     "snapshot in favour of step %d", self._pending.step,
@@ -223,14 +234,27 @@ class AsyncCheckpointManager:
             self._cv.wait_for(
                 lambda: self._pending is None and not self._writing)
 
+    def _drain_at_exit(self) -> None:
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — never raise during teardown
+            pass
+
     def close(self) -> None:
-        if self._thread is None:
-            return
-        self.wait()
-        with self._cv:
-            self._closed = True
-            self._cv.notify_all()
-        self._thread.join(timeout=30)
+        if self._thread is not None:
+            self.wait()
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+            self._thread.join(timeout=30)
+            self._thread = None
+            import atexit
+            try:
+                atexit.unregister(self._drain_at_exit)
+            except Exception:  # noqa: BLE001
+                pass
+        # Sync-write managers have no thread but still own the metrics
+        # handle — close it regardless so the final line is flushed.
         self.metrics.close()
 
     # ------------------------------------------------------------------
@@ -252,33 +276,59 @@ class AsyncCheckpointManager:
                     self._writing = False
                     self._cv.notify_all()
 
+    def _span(self, name: str, **args):
+        """Tracer span when a telemetry facade was handed in (no-op
+        otherwise) — ckpt_snapshot/ckpt_write show up in the step trace."""
+        if self.telemetry is not None:
+            return self.telemetry.span(name, **args)
+        import contextlib
+        return contextlib.nullcontext()
+
+    def _counter(self, name: str, step: int) -> None:
+        if self.telemetry is not None:
+            self.telemetry.registry.counter(name).inc(step=step)
+
     def _write_with_retries(self, snap: _Snapshot) -> None:
         t0 = time.monotonic()
-        for attempt in range(self.max_retries + 1):
-            try:
-                path = self._write_once(snap)
-                break
-            except Exception as e:  # noqa: BLE001 — retry any write fault
-                self.last_error = e
-                if attempt >= self.max_retries:
-                    self.stats["failed"] += 1
-                    logger.error(
-                        "checkpoint step %d failed after %d attempts: %s",
-                        snap.step, attempt + 1, e)
-                    return
-                self.stats["retries"] += 1
-                delay = self.backoff * (2 ** attempt)
-                logger.warning(
-                    "checkpoint step %d write attempt %d failed (%s); "
-                    "retrying in %.3fs", snap.step, attempt + 1, e, delay)
-                time.sleep(delay)
+        with self._span("ckpt_write", step=snap.step):
+            for attempt in range(self.max_retries + 1):
+                try:
+                    path = self._write_once(snap)
+                    break
+                except Exception as e:  # noqa: BLE001 — retry any write fault
+                    self.last_error = e
+                    if attempt >= self.max_retries:
+                        self.stats["failed"] += 1
+                        self._counter("ckpt/failed", step=snap.step)
+                        logger.error(
+                            "checkpoint step %d failed after %d attempts: %s",
+                            snap.step, attempt + 1, e)
+                        return
+                    self.stats["retries"] += 1
+                    self._counter("ckpt/retries", step=snap.step)
+                    delay = self.backoff * (2 ** attempt)
+                    logger.warning(
+                        "checkpoint step %d write attempt %d failed (%s); "
+                        "retrying in %.3fs", snap.step, attempt + 1, e, delay)
+                    time.sleep(delay)
         latency = time.monotonic() - t0
         self.stats["saved"] += 1
+        # The JSONL-beside-the-checkpoints file keeps its contract (the
+        # auto-resume probe and supervisor read it); the registry fans the
+        # same scalars out to every configured telemetry sink.
         self.metrics.add_scalar("Train/Checkpoint/write_latency_sec",
                                 latency, snap.step)
         self.metrics.add_scalar("Train/Checkpoint/snapshot_sec",
                                 snap.meta.get("snapshot_sec", 0.0), snap.step)
-        if self.monitor is not None:
+        if self.telemetry is not None:
+            reg = self.telemetry.registry
+            reg.gauge("ckpt/write_latency_sec").set(latency, step=snap.step)
+            reg.gauge("ckpt/snapshot_sec").set(
+                snap.meta.get("snapshot_sec", 0.0), step=snap.step)
+            self._counter("ckpt/saved", step=snap.step)
+        elif self.monitor is not None:
+            # No facade (standalone manager construction): legacy direct
+            # monitor emission.
             self.monitor.add_scalar("Train/Checkpoint/write_latency_sec",
                                     latency, snap.step)
         logger.info("checkpoint step %d committed to %s (%.3fs)",

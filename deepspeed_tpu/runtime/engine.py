@@ -274,6 +274,13 @@ class TPUEngine:
                 theta=config.pld.theta, gamma=config.pld.gamma)
         from deepspeed_tpu.utils.monitor import build_monitor
         self.monitor = build_monitor(config.tensorboard)
+        # Unified observability facade (telemetry/; docs/OBSERVABILITY.md):
+        # metrics registry + step tracer + recompile detector. A legacy
+        # tensorboard block rides as a registry sink, so scalar emission has
+        # ONE call site; disabled telemetry is a no-op facade.
+        from deepspeed_tpu.telemetry import build_telemetry
+        self.telemetry = build_telemetry(config.telemetry,
+                                         monitor=self.monitor)
         self.moq = None
         if config.quantize_training.get("enabled", False):
             if self._offload_cfg.enabled and self._offload_cfg.device == "nvme":
@@ -320,11 +327,17 @@ class TPUEngine:
                 backoff=rcfg.checkpoint.backoff_seconds,
                 async_write=rcfg.checkpoint.async_write,
                 fault_plan=self.fault_plan,
-                monitor=self.monitor)
-        self.timers = SynchronizedWallClockTimer()
+                monitor=self.monitor,
+                telemetry=self.telemetry)
+        # Device-sync barriers in the timers are gated on wall_clock_breakdown:
+        # a breakdown-off run must not pay a block_until_ready round-trip per
+        # step just to feed timings nobody reads.
+        self.timers = SynchronizedWallClockTimer(
+            enabled=config.wall_clock_breakdown)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size,
-            steps_per_output=self.steps_per_print)
+            steps_per_output=self.steps_per_print,
+            sync=config.wall_clock_breakdown)
         self._micro_in_window = 0
         self._pending_micro = []
         self._last_loss = None
@@ -1069,17 +1082,33 @@ class TPUEngine:
         return jax.tree_util.tree_map(put, batch)
 
     def forward(self, batch):
-        """Compute loss and accumulate grads for one micro-batch."""
+        """Compute loss and accumulate grads for one micro-batch.
+
+        Trace attribution: ``_micro_step`` is ONE fused XLA program running
+        forward *and* backward, so with sync'd spans the "forward" span
+        carries the whole fwd+bwd compute and the "backward" span (emitted
+        by :meth:`backward`) records only the host-side API point — XLA
+        offers no host-observable seam inside a program; use the
+        ``jax_profiler_dir`` passthrough for intra-program breakdown."""
         if self._micro_step is None:
             return self._compat_forward(batch)
+        tel = self.telemetry
         if self.wall_clock_breakdown:
             self.timers("forward").start()
         if self.progressive_layer_drop is not None and isinstance(batch, dict):
             theta = self.progressive_layer_drop.update_state(self.global_steps)
             batch = dict(batch)
             batch["pld_theta"] = np.float32(theta)
-        batch = self.put_batch(batch)
-        self.state, loss, _ = self._micro_step(self.state, batch)
+        if self.wall_clock_breakdown:
+            self.timers("dataloader").start()
+        with tel.span("dataloader", step=self.global_steps):
+            batch = self.put_batch(batch)
+        if self.wall_clock_breakdown:
+            self.timers("dataloader").stop()
+        tel.check_recompile("engine.micro_step", batch,
+                            step=self.global_steps)
+        with tel.span("forward", step=self.global_steps):
+            self.state, loss, _ = self._micro_step(self.state, batch)
         self._last_loss = loss
         if self.wall_clock_breakdown:
             self.timers("forward").stop()
@@ -1115,7 +1144,14 @@ class TPUEngine:
     def backward(self, loss=None, allreduce_gradients: bool = True):
         """API-parity no-op: gradients were produced in forward's value_and_grad
         (an XLA program has no separate backward dispatch). Kept so reference
-        training loops run unchanged."""
+        training loops run unchanged. The backward span/timer records the
+        host-side API point (near-zero by construction — see
+        :meth:`forward`'s trace-attribution note)."""
+        if self.wall_clock_breakdown:
+            self.timers("backward").start()
+            self.timers("backward").stop()
+        with self.telemetry.span("backward", step=self.global_steps):
+            pass
         self.micro_steps += 1
         self._micro_in_window += 1
         return loss if loss is not None else self._last_loss
@@ -1141,7 +1177,8 @@ class TPUEngine:
         if self.wall_clock_breakdown:
             self.timers("step").start()
         lr = self._current_lr()
-        self.state, overflow, _ = self._apply_step(self.state, lr)
+        with self.telemetry.span("optimizer_step", step=self.global_steps):
+            self.state, overflow, _ = self._apply_step(self.state, lr)
         self._micro_in_window = 0
         self.global_steps += 1
         if self.lr_scheduler is not None:
@@ -1155,7 +1192,30 @@ class TPUEngine:
                      ranks=[0])
         if self._last_loss is not None:
             self._post_step_hooks(self._last_loss)
+        self._emit_step_telemetry()
         self._resilience_step_hook()
+
+    def _emit_step_telemetry(self) -> None:
+        """Per-step registry emission: HBM watermark gauges (peak +
+        in-use, the OOM-margin signal), default step stamp, and a periodic
+        trace-file flush (atomic rewrite at steps_per_print cadence so a
+        preemption keeps a recent trace without O(steps^2) rewriting)."""
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        tel.set_step(self.global_steps)
+        stats = None
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 — CPU backends may not report
+            stats = None
+        if stats:
+            tel.registry.gauge("engine/hbm_peak_bytes").set(
+                stats.get("peak_bytes_in_use", 0), step=self.global_steps)
+            tel.registry.gauge("engine/hbm_bytes_in_use").set(
+                stats.get("bytes_in_use", 0), step=self.global_steps)
+        if self.global_steps % self.steps_per_print == 0:
+            tel.flush()
 
     def _maybe_profile(self, fn, *args, params=None):
         """Emit the flops report at profile_step. lower+compile only
@@ -1230,26 +1290,41 @@ class TPUEngine:
             else:
                 self.state = self.state._replace(params=self.moq.quantize_tree(
                     self.state.params, self.global_steps, key))
-        if self.monitor is not None:
-            self.monitor.add_scalar("Train/Samples/train_loss", float(loss),
-                                    self.global_steps)
-            self.monitor.add_scalar("Train/Samples/lr",
-                                    float(self._current_lr()),
-                                    self.global_steps)
+        # Scalar emission goes through the telemetry registry, which fans
+        # out to every configured sink (a legacy tensorboard block rides as
+        # a sink — build_telemetry). The sink check also gates the host
+        # fetches: float(loss) forces a device sync nobody needs when no
+        # sink listens.
+        reg = self.telemetry.registry
+        if reg.sinks:
+            reg.add_scalar("Train/Samples/train_loss", float(loss),
+                           self.global_steps)
+            reg.add_scalar("Train/Samples/lr", float(self._current_lr()),
+                           self.global_steps)
             if self.config.fp16.enabled:
-                self.monitor.add_scalar("Train/Samples/loss_scale",
-                                        float(self.state.loss_scale.scale),
-                                        self.global_steps)
+                reg.add_scalar("Train/Samples/loss_scale",
+                               float(self.state.loss_scale.scale),
+                               self.global_steps)
 
     def train_batch(self, batches) -> jax.Array:
         """Fused full step: ``batches`` is a pytree whose leaves have leading
         dim gradient_accumulation_steps (one entry per micro-batch)."""
         self._pending_micro = []   # direct call supersedes any stashed loop
+        tel = self.telemetry
         self.tput_timer.start()
-        batches = self.put_batch(self._inject_pld(self._stash_moq_probe(batches)),
-                                 leading_gas_dim=True)
+        if self.wall_clock_breakdown:
+            self.timers("dataloader").start()
+        with tel.span("dataloader", step=self.global_steps):
+            batches = self.put_batch(
+                self._inject_pld(self._stash_moq_probe(batches)),
+                leading_gas_dim=True)
+        if self.wall_clock_breakdown:
+            self.timers("dataloader").stop()
+        tel.check_recompile("engine.train_step", batches,
+                            step=self.global_steps)
         if self._train_step is None:  # offloaded optimizer tier
-            loss = self._offload_train_batch(batches)
+            with tel.span("train_step", step=self.global_steps):
+                loss = self._offload_train_batch(batches)
             self.global_steps += 1
             self.micro_steps += self.gradient_accumulation_steps
             if self.lr_scheduler is not None:
@@ -1259,12 +1334,15 @@ class TPUEngine:
             if self.config.check_numerics:
                 self._check_numerics(loss, overflow=False)
             self._post_step_hooks(loss)
+            self._emit_step_telemetry()
             self._resilience_step_hook()
             return loss
         lr = self._current_lr()
         self._maybe_profile(self._train_step, self.state, batches, lr,
                             params=self.state.params)
-        self.state, loss, overflow, _ = self._train_step(self.state, batches, lr)
+        with tel.span("train_step", step=self.global_steps):
+            self.state, loss, overflow, _ = self._train_step(self.state,
+                                                             batches, lr)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
         if self.lr_scheduler is not None:
@@ -1274,6 +1352,7 @@ class TPUEngine:
         if self.config.check_numerics:
             self._check_numerics(loss, overflow=bool(overflow))
         self._post_step_hooks(loss)
+        self._emit_step_telemetry()
         self._resilience_step_hook()
         return loss
 
@@ -1309,6 +1388,7 @@ class TPUEngine:
 
     def eval_batch(self, batch):
         batch = self.put_batch(batch)
+        self.telemetry.check_recompile("engine.eval_step", batch)
         if self._eval_step is None:  # offload tier: params already compute-dtype
             loss, _ = self._offload_eval(self._compute_params, batch)
             return loss

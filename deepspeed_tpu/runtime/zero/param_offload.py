@@ -53,8 +53,27 @@ def _pick_host_memory_kind() -> str:
     return "pinned_host"
 
 
-HOST_MEMORY_KIND = _pick_host_memory_kind()
+# Resolved lazily on first use: probing jax.devices() at import time would
+# initialise the backend and break the init_distributed() ordering invariant
+# (parallel/mesh.py — a backend query before jax.distributed.initialize
+# silently degrades a pod to disconnected single-process runs).
+_HOST_MEMORY_KIND: str = ""
 _TO_DEVICE = DEVICE_MEMORY_SPACE
+
+
+def host_memory_kind() -> str:
+    global _HOST_MEMORY_KIND
+    if not _HOST_MEMORY_KIND:
+        _HOST_MEMORY_KIND = _pick_host_memory_kind()
+    return _HOST_MEMORY_KIND
+
+
+def __getattr__(name: str):
+    # Back-compat for the old module constant (probes the backend, so it
+    # must stay lazy).
+    if name == "HOST_MEMORY_KIND":
+        return host_memory_kind()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def fetch(tree: Any) -> Any:
@@ -99,7 +118,8 @@ def host_storage_specs(tree: Any, data_size: int,
 
 def host_shardings(mesh, specs: Any) -> Any:
     return jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s, memory_kind=HOST_MEMORY_KIND), specs)
+        lambda s: NamedSharding(mesh, s, memory_kind=host_memory_kind()),
+        specs)
 
 
 def place_host(tree: Any, mesh, specs: Any) -> Any:

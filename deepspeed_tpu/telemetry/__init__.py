@@ -1,0 +1,114 @@
+"""Unified observability layer (docs/OBSERVABILITY.md).
+
+Three pieces behind one facade:
+
+- :class:`MetricsRegistry` — counters / gauges / histograms with tags and
+  pluggable sinks (JSONL, tensorboard, in-memory);
+- :class:`StepTracer` — Chrome trace-event spans (Perfetto-viewable) with
+  device-sync barriers gated on the tracer being enabled;
+- :class:`RecompileDetector` — fingerprints jitted-step inputs and warns
+  loudly when the same step function silently retraces.
+
+``build_telemetry(config.telemetry, ...)`` wires all three from the
+``telemetry`` config block; a disabled block yields the same facade with
+every path no-op'd (zero sinks, reusable null span, detector off), so call
+sites never branch on "is telemetry on".
+"""
+
+import os
+from typing import Optional
+
+from deepspeed_tpu.telemetry.recompile import (RECOMPILE_COUNTER,
+                                               RecompileDetector,
+                                               tree_signature)
+from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram,
+                                              InMemorySink, JSONLSink,
+                                              MetricsRegistry, Sink,
+                                              TensorboardSink)
+from deepspeed_tpu.telemetry.tracer import StepTracer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "InMemorySink", "JSONLSink",
+    "MetricsRegistry", "RecompileDetector", "RECOMPILE_COUNTER", "Sink",
+    "StepTracer", "Telemetry", "TensorboardSink", "build_telemetry",
+    "tree_signature",
+]
+
+
+class Telemetry:
+    """The facade the engines hold: ``.registry``, ``.tracer``,
+    ``.recompile`` plus convenience passthroughs."""
+
+    def __init__(self, registry: MetricsRegistry, tracer: StepTracer,
+                 recompile: RecompileDetector, enabled: bool = True):
+        self.registry = registry
+        self.tracer = tracer
+        self.recompile = recompile
+        self.enabled = bool(enabled)
+
+    # passthroughs used on the hot path — kept one attribute deep
+    def span(self, name: str, **args):
+        return self.tracer.span(name, **args)
+
+    def check_recompile(self, fn_name: str, *trees,
+                        step: Optional[int] = None) -> str:
+        return self.recompile.check(fn_name, *trees, step=step)
+
+    def set_step(self, step: int) -> None:
+        self.registry.set_step(step)
+
+    def flush(self) -> None:
+        self.registry.flush()
+        self.tracer.flush()
+
+    def close(self) -> None:
+        self.tracer.close()
+        self.registry.close()
+
+
+def null_telemetry() -> Telemetry:
+    """A fully disabled facade (no sinks, no trace, detector off)."""
+    return Telemetry(MetricsRegistry(), StepTracer(enabled=False),
+                     RecompileDetector(enabled=False), enabled=False)
+
+
+def build_telemetry(tcfg, monitor=None) -> Telemetry:
+    """Build the facade from a parsed ``TelemetryConfig``.
+
+    ``monitor``: an already-built ``TensorboardMonitor`` (the engine's
+    ``tensorboard`` block) — attached as a registry sink so legacy
+    tensorboard configs receive every registry metric without listing
+    "tensorboard" in the telemetry sinks.
+    """
+    if tcfg is None or not tcfg.enabled:
+        tel = null_telemetry()
+        if monitor is not None:
+            # tensorboard-only legacy setups still get registry fan-out
+            tel.registry.add_sink(TensorboardSink(monitor))
+        return tel
+
+    registry = MetricsRegistry()
+    for sink_name in tcfg.metrics.sinks:
+        if sink_name == "jsonl":
+            registry.add_sink(JSONLSink(
+                os.path.join(tcfg.dir, tcfg.metrics.file)))
+        elif sink_name == "memory":
+            registry.add_sink(InMemorySink())
+        elif sink_name == "tensorboard":
+            if monitor is not None:
+                registry.add_sink(TensorboardSink(monitor))
+            else:
+                from deepspeed_tpu.utils.monitor import TensorboardMonitor
+                registry.add_sink(TensorboardSink(
+                    TensorboardMonitor(tcfg.dir, job_name="telemetry")))
+    if monitor is not None and "tensorboard" not in tcfg.metrics.sinks:
+        registry.add_sink(TensorboardSink(monitor))
+
+    tracer = StepTracer(
+        path=(os.path.join(tcfg.dir, tcfg.trace.file)
+              if tcfg.trace.enabled else None),
+        sync_spans=tcfg.trace.sync_spans,
+        jax_profiler_dir=tcfg.trace.jax_profiler_dir)
+    recompile = RecompileDetector(registry=registry, tracer=tracer,
+                                  enabled=tcfg.recompile_detection)
+    return Telemetry(registry, tracer, recompile, enabled=True)

@@ -1,0 +1,236 @@
+"""Metrics registry — counters / gauges / histograms with tags and sinks.
+
+One facade subsuming the two pre-existing scalar writers
+(``utils/monitor.py``: ``MetricsJSONL`` and ``TensorboardMonitor``): every
+subsystem emits through a :class:`MetricsRegistry` and the registry fans out
+to whatever sinks are configured — JSONL (append-only, crash-tolerant),
+tensorboard (via the existing monitor), or in-memory (tests/probes). With no
+sinks attached every emit is a single attribute check, so an engine with
+telemetry disabled pays nothing.
+
+The row schema extends the established ``{tag, value, step}`` JSONL contract
+(resilience metrics readers keep working) with ``kind`` and flattened tags,
+so one file serves counters, gauges and histogram observations alike.
+"""
+
+import bisect
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Sink:
+    """Sink interface: receives every metric emission."""
+
+    def emit(self, kind: str, name: str, value: float, step: int,
+             tags: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class JSONLSink(Sink):
+    """Append-only JSONL rows ``{tag, value, step, kind, ...tags}`` (the
+    ``MetricsJSONL`` schema plus kind/tags — readers of the old schema parse
+    these rows unchanged)."""
+
+    def __init__(self, path: str):
+        from deepspeed_tpu.utils.monitor import MetricsJSONL
+        self._jsonl = MetricsJSONL(path)
+        self.path = path
+
+    def emit(self, kind, name, value, step, tags):
+        self._jsonl.add_scalar(name, value, step, kind=kind, **tags)
+
+    def flush(self):
+        self._jsonl.flush()
+
+    def close(self):
+        self._jsonl.close()
+
+
+class TensorboardSink(Sink):
+    """Routes through a ``TensorboardMonitor`` (or any ``add_scalar`` object).
+    Tags are folded into the tag path (``name[k=v]``) because TB scalars have
+    no tag dimension."""
+
+    def __init__(self, monitor):
+        self.monitor = monitor
+
+    def emit(self, kind, name, value, step, tags):
+        if tags:
+            suffix = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            name = f"{name}[{suffix}]"
+        self.monitor.add_scalar(name, value, step)
+
+    def flush(self):
+        self.monitor.flush()
+
+    def close(self):
+        self.monitor.close()
+
+
+class InMemorySink(Sink):
+    """Keeps every emission as a dict row — the test/probe sink."""
+
+    def __init__(self):
+        self.rows: List[Dict[str, Any]] = []
+
+    def emit(self, kind, name, value, step, tags):
+        row = {"kind": kind, "tag": name, "value": float(value),
+               "step": int(step)}
+        row.update(tags)
+        self.rows.append(row)
+
+    def values(self, name: str) -> List[float]:
+        return [r["value"] for r in self.rows if r["tag"] == name]
+
+    def tags(self) -> set:
+        return {r["tag"] for r in self.rows}
+
+
+class _Metric:
+    def __init__(self, registry: "MetricsRegistry", name: str,
+                 tags: Optional[Dict[str, Any]] = None):
+        self._registry = registry
+        self.name = name
+        self.tags = dict(tags or {})
+
+
+class Counter(_Metric):
+    """Monotonic count; emits the RUNNING TOTAL (so the newest row is the
+    current value and JSONL readers need no summing)."""
+
+    def __init__(self, registry, name, tags=None):
+        super().__init__(registry, name, tags)
+        self.total = 0.0
+
+    def inc(self, n: float = 1.0, step: Optional[int] = None, **tags) -> None:
+        self.total += n
+        self._registry._emit("counter", self.name, self.total, step,
+                             {**self.tags, **tags})
+
+
+class Gauge(_Metric):
+    """Point-in-time value."""
+
+    def __init__(self, registry, name, tags=None):
+        super().__init__(registry, name, tags)
+        self.value: Optional[float] = None
+
+    def set(self, value: float, step: Optional[int] = None, **tags) -> None:
+        self.value = float(value)
+        self._registry._emit("gauge", self.name, self.value, step,
+                             {**self.tags, **tags})
+
+
+class Histogram(_Metric):
+    """Distribution: every observation is emitted, and a bounded sorted
+    reservoir keeps percentiles queryable host-side (``percentile``)."""
+
+    def __init__(self, registry, name, tags=None, max_samples: int = 4096):
+        super().__init__(registry, name, tags)
+        self._sorted: List[float] = []
+        self._max = int(max_samples)
+        self.count = 0
+
+    def observe(self, value: float, step: Optional[int] = None,
+                **tags) -> None:
+        value = float(value)
+        self.count += 1
+        if len(self._sorted) < self._max:
+            bisect.insort(self._sorted, value)
+        self._registry._emit("histogram", self.name, value, step,
+                             {**self.tags, **tags})
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; linear interpolation over the reservoir."""
+        if not self._sorted:
+            raise ValueError(f"histogram {self.name!r} has no observations")
+        s = self._sorted
+        if len(s) == 1:
+            return s[0]
+        pos = (q / 100.0) * (len(s) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(s) - 1)
+        return s[lo] + (s[hi] - s[lo]) * (pos - lo)
+
+    def percentiles(self, qs: Sequence[float]) -> Tuple[float, ...]:
+        return tuple(self.percentile(q) for q in qs)
+
+
+class MetricsRegistry:
+    """Named metrics + fan-out to sinks. Thread-safe: the checkpoint writer
+    thread emits concurrently with the step loop."""
+
+    def __init__(self, sinks: Optional[Iterable[Sink]] = None):
+        self._sinks: List[Sink] = list(sinks or [])
+        self._metrics: Dict[Tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+        self._step = 0
+
+    # -- construction ---------------------------------------------------
+    def add_sink(self, sink: Sink) -> Sink:
+        self._sinks.append(sink)
+        return sink
+
+    @property
+    def sinks(self) -> List[Sink]:
+        return list(self._sinks)
+
+    def _get(self, kind: str, cls, name: str, **kw):
+        key = (kind, name)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._metrics[key] = cls(self, name, **kw)
+            return m
+
+    def counter(self, name: str, **kw) -> Counter:
+        return self._get("counter", Counter, name, **kw)
+
+    def gauge(self, name: str, **kw) -> Gauge:
+        return self._get("gauge", Gauge, name, **kw)
+
+    def histogram(self, name: str, **kw) -> Histogram:
+        return self._get("histogram", Histogram, name, **kw)
+
+    # -- emission -------------------------------------------------------
+    def set_step(self, step: int) -> None:
+        """Default step stamped on emissions that don't pass one."""
+        self._step = int(step)
+
+    def _emit(self, kind: str, name: str, value: float,
+              step: Optional[int], tags: Dict[str, Any]) -> None:
+        if not self._sinks:
+            return
+        step = self._step if step is None else int(step)
+        with self._lock:
+            for sink in self._sinks:
+                try:
+                    sink.emit(kind, name, value, step, tags)
+                except Exception as e:  # noqa: BLE001 — a broken sink must
+                    # never take down the training loop it observes
+                    logger.warning("telemetry sink %s failed on %s: %s",
+                                   type(sink).__name__, name, e)
+
+    def add_scalar(self, tag: str, value: float, step: int, **extra) -> None:
+        """Monitor-compat facade: gauge semantics under the old signature,
+        so ``monitor.add_scalar`` call sites migrate by renaming only."""
+        self.gauge(tag).set(value, step=step, **extra)
+
+    def flush(self) -> None:
+        with self._lock:
+            for sink in self._sinks:
+                sink.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            for sink in self._sinks:
+                sink.close()
+            self._sinks = []

@@ -1,0 +1,234 @@
+"""Step tracer — Chrome trace-event spans for the training loop.
+
+Records named spans (dataloader / forward / backward / optimizer_step /
+ckpt_snapshot / ckpt_write / ...) as Chrome trace-event JSON, the format
+Perfetto and ``chrome://tracing`` open directly, plus instant and counter
+events. ``tools/trace_report.py`` renders the same file as a per-span time
+breakdown table.
+
+Span semantics on an async-dispatch runtime: XLA queues device work and
+returns, so a host-side wall-clock span around a dispatch measures the
+*dispatch*, not the compute. When ``sync_spans`` is on (the default for an
+enabled tracer), the tracer drains the device queue at every span boundary —
+the span then brackets exactly the device work issued inside it, which is
+the T3-style "where does step time go" attribution. The sync barrier is
+gated on the tracer being enabled: a disabled tracer's ``span()`` is a
+reusable no-op context manager that performs **zero** ``block_until_ready``
+calls and no allocation beyond one attribute check.
+
+Optional ``jax.profiler`` passthrough: give ``jax_profiler_dir`` and the
+tracer starts a profiler session alongside (device-level XLA timeline, for
+the cases where host spans aren't enough).
+"""
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _device_sync() -> None:
+    """Drain the device queue. Routed through ``utils.timer`` so the whole
+    codebase has ONE sync primitive (tests count calls by patching it)."""
+    from deepspeed_tpu.utils import timer as _timer
+
+    _timer._device_synchronize()
+
+
+class _NullSpan:
+    """Reusable no-op context manager for the disabled tracer."""
+
+    __slots__ = ()
+    duration = 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "args", "_t0", "duration")
+
+    def __init__(self, tracer: "StepTracer", name: str, args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self._t0 = 0.0
+        self.duration = 0.0
+
+    def __enter__(self):
+        if self._tracer.sync_spans:
+            _device_sync()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._tracer.sync_spans:
+            _device_sync()
+        t1 = time.perf_counter()
+        self.duration = t1 - self._t0
+        self._tracer._record(self.name, self._t0, t1, self.args)
+        return False
+
+
+class StepTracer:
+    """Chrome trace-event recorder. Thread-safe (the checkpoint writer
+    thread emits ckpt_write spans concurrently with the step loop).
+
+    Bounded: at most ``max_events`` events are held (a ring — the OLDEST
+    are dropped first, keeping the recent window that matters for triage;
+    ``dropped_events`` counts evictions and the saved trace carries the
+    count as metadata). This caps both host RAM and the cost of each
+    ``save()`` rewrite at a constant, so periodic flushing over an
+    arbitrarily long run does O(steps × max_events) work, never
+    O(steps²). ``save()`` is also skipped when nothing was recorded since
+    the last write."""
+
+    def __init__(self, path: Optional[str] = None, enabled: Optional[bool] = None,
+                 sync_spans: bool = True,
+                 jax_profiler_dir: Optional[str] = None,
+                 max_events: int = 200_000):
+        self.path = path
+        self.enabled = bool(path) if enabled is None else bool(enabled)
+        # Sync barriers strictly require an enabled tracer — the zero-cost
+        # contract of disabled telemetry.
+        self.sync_spans = bool(sync_spans) and self.enabled
+        self.jax_profiler_dir = jax_profiler_dir
+        self._events = collections.deque(maxlen=int(max_events))
+        self.dropped_events = 0
+        self._dirty = False
+        self._lock = threading.Lock()
+        self._epoch = time.perf_counter()
+        self._pid = os.getpid()
+        self._profiler_active = False
+        if self.enabled:
+            self._meta("process_name", {"name": "deepspeed_tpu"})
+            if jax_profiler_dir:
+                self.start_jax_profiler()
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        """Caller holds the lock."""
+        if len(self._events) == self._events.maxlen:
+            self.dropped_events += 1
+        self._events.append(ev)
+        self._dirty = True
+
+    # -- event helpers --------------------------------------------------
+    def _us(self, t: float) -> float:
+        return (t - self._epoch) * 1e6
+
+    def _meta(self, name: str, args: Dict[str, Any]) -> None:
+        with self._lock:
+            self._append({"name": name, "ph": "M", "pid": self._pid,
+                          "tid": threading.get_ident(), "args": args})
+
+    def _record(self, name: str, t0: float, t1: float,
+                args: Dict[str, Any]) -> None:
+        ev = {"name": name, "ph": "X", "pid": self._pid,
+              "tid": threading.get_ident(), "ts": self._us(t0),
+              "dur": (t1 - t0) * 1e6}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._append(ev)
+
+    # -- public API -----------------------------------------------------
+    def span(self, name: str, **args):
+        """Context manager timing the enclosed region (no-op when
+        disabled). The returned handle exposes ``.duration`` (seconds)
+        after exit."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args) -> None:
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "i", "s": "t", "pid": self._pid,
+              "tid": threading.get_ident(),
+              "ts": self._us(time.perf_counter())}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._append(ev)
+
+    def counter(self, name: str, value: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._append({
+                "name": name, "ph": "C", "pid": self._pid,
+                "tid": threading.get_ident(),
+                "ts": self._us(time.perf_counter()),
+                "args": {"value": float(value)}})
+
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def span_names(self) -> set:
+        with self._lock:
+            return {e["name"] for e in self._events if e.get("ph") == "X"}
+
+    # -- jax.profiler passthrough --------------------------------------
+    def start_jax_profiler(self) -> None:
+        if self._profiler_active or not self.jax_profiler_dir:
+            return
+        try:
+            import jax
+            os.makedirs(self.jax_profiler_dir, exist_ok=True)
+            jax.profiler.start_trace(self.jax_profiler_dir)
+            self._profiler_active = True
+        except Exception as e:  # noqa: BLE001 — profiler is best-effort
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning("jax.profiler passthrough unavailable: %s", e)
+
+    def stop_jax_profiler(self) -> None:
+        if not self._profiler_active:
+            return
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            pass
+        self._profiler_active = False
+
+    # -- persistence ----------------------------------------------------
+    def save(self) -> Optional[str]:
+        """Write the trace file (atomic rename). Cheap to call on a cadence:
+        a no-op when nothing was recorded since the last write, and the
+        rewrite cost is capped by ``max_events`` — a preemption loses at
+        most the events since the previous flush."""
+        if not self.enabled or not self.path:
+            return None
+        with self._lock:
+            if not self._dirty:
+                return self.path
+            events = list(self._events)
+            dropped = self.dropped_events
+            self._dirty = False
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if dropped:
+            doc["metadata"] = {"dropped_events": dropped}
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.path)
+        return self.path
+
+    flush = save
+
+    def close(self) -> None:
+        self.stop_jax_profiler()
+        self.save()

@@ -36,12 +36,23 @@ class MetricsJSONL:
         self._lock = threading.Lock()
         self._f = open(path, "a", buffering=1)
 
-    def add_scalar(self, tag: str, value: float, step: int) -> None:
+    def add_scalar(self, tag: str, value: float, step: int, **extra) -> None:
+        """Append one row; ``extra`` key/values ride on the same row (the
+        ``[extra]`` field of the schema — e.g. ``kind=`` from the telemetry
+        registry, attempt counters from the resilience writer)."""
         with self._lock:
             if self._f.closed:
                 return
-            self._f.write(json.dumps(
-                {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+            row = {"tag": tag, "value": float(value), "step": int(step)}
+            if extra:
+                row.update(extra)
+            self._f.write(json.dumps(row) + "\n")
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                os.fsync(self._f.fileno())
 
     def read(self, tag: Optional[str] = None):
         """All recorded rows (optionally one tag) — test/probe convenience."""
@@ -79,15 +90,19 @@ class TensorboardMonitor:
             self._jsonl = MetricsJSONL(
                 os.path.join(self.log_dir, "scalars.jsonl"))
 
-    def add_scalar(self, tag: str, value: float, step: int) -> None:
+    def add_scalar(self, tag: str, value: float, step: int, **extra) -> None:
         if self._writer is not None:
+            # SummaryWriter has no extra-field dimension; extras are dropped
+            # there but preserved on the JSONL fallback rows.
             self._writer.add_scalar(tag, float(value), int(step))
         else:
-            self._jsonl.add_scalar(tag, value, step)
+            self._jsonl.add_scalar(tag, value, step, **extra)
 
     def flush(self) -> None:
         if self._writer is not None:
             self._writer.flush()
+        if self._jsonl is not None:
+            self._jsonl.flush()
 
     def close(self) -> None:
         if self._writer is not None:

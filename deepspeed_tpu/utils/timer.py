@@ -5,6 +5,15 @@ Capability parity with the reference's ``deepspeed/utils/timer.py``
 ``synchronize()`` barrier becomes a block-until-ready on the JAX default
 device: XLA dispatch is async exactly like CUDA streams, so timers must drain
 the device queue before reading the host clock.
+
+The barrier is GATED: a timer whose owner is disabled (wall-clock logging
+off) reads the host clock without draining the device — a per-step
+``block_until_ready`` round-trip is exactly the overhead the timing exists
+to measure, so it must not be paid when nobody reads the timings. Probes
+that need an exact barrier regardless pass ``force_sync=True``.
+``_device_synchronize`` is the single sync primitive for all of telemetry
+(the tracer routes through it too), so tests can count every
+telemetry-originated sync by patching one function.
 """
 
 import time
@@ -26,22 +35,27 @@ def _device_synchronize() -> None:
 
 
 class _Timer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, owner: Optional["SynchronizedWallClockTimer"] = None):
         self.name_ = name
         self.elapsed_ = 0.0
         self.started_ = False
         self.start_time = 0.0
         self.count = 0
+        self._owner = owner
 
-    def start(self) -> None:
+    def _sync(self, force: bool) -> None:
+        if force or self._owner is None or self._owner.enabled:
+            _device_synchronize()
+
+    def start(self, force_sync: bool = False) -> None:
         assert not self.started_, f"timer {self.name_} has already been started"
-        _device_synchronize()
+        self._sync(force_sync)
         self.start_time = time.time()
         self.started_ = True
 
-    def stop(self, reset: bool = False) -> None:
+    def stop(self, reset: bool = False, force_sync: bool = False) -> None:
         assert self.started_, f"timer {self.name_} is not started"
-        _device_synchronize()
+        self._sync(force_sync)
         if reset:
             self.elapsed_ = time.time() - self.start_time
         else:
@@ -70,14 +84,18 @@ class _Timer:
 
 
 class SynchronizedWallClockTimer:
-    """Named timers with device synchronisation, used for wall-clock breakdown."""
+    """Named timers with device synchronisation, used for wall-clock
+    breakdown. ``enabled=False`` keeps the timers usable (host clocks only)
+    but skips every device barrier — the engine constructs it from
+    ``wall_clock_breakdown`` so breakdown-off runs pay zero syncs."""
 
-    def __init__(self):
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
         self.timers: Dict[str, _Timer] = {}
 
     def __call__(self, name: str) -> _Timer:
         if name not in self.timers:
-            self.timers[name] = _Timer(name)
+            self.timers[name] = _Timer(name, owner=self)
         return self.timers[name]
 
     def has_timer(self, name: str) -> bool:
@@ -109,10 +127,17 @@ class SynchronizedWallClockTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec tracker, skipping warm-up steps (reference ``timer.py:100``)."""
+    """Samples/sec tracker, skipping warm-up steps (reference ``timer.py:100``).
+
+    ``sync=False`` skips the per-step device barriers: window durations then
+    measure dispatch+queue time, which converges to device step time in
+    steady state (the host can't run ahead of a bounded queue) — accurate
+    enough for the periodic throughput print, and free. The engine enables
+    barriers only when ``wall_clock_breakdown`` asks for exact timings."""
 
     def __init__(self, batch_size: int, start_step: int = 2,
-                 steps_per_output: Optional[int] = None, monitor_memory: bool = False):
+                 steps_per_output: Optional[int] = None,
+                 monitor_memory: bool = False, sync: bool = True):
         self.start_time = 0.0
         self.end_time = 0.0
         self.started = False
@@ -124,18 +149,17 @@ class ThroughputTimer:
         self.total_elapsed_time = 0.0
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
+        self.sync = bool(sync)
 
     def update_epoch_count(self) -> None:
         self.epoch_count += 1
         self.micro_step_count = 0
 
-    def _init_timer(self) -> None:
-        self.initialized = True
-
     def start(self) -> None:
         self.started = True
         if self.global_step_count >= self.start_step:
-            _device_synchronize()
+            if self.sync:
+                _device_synchronize()
             self.start_time = time.time()
 
     def stop(self, report_speed: bool = True) -> None:
@@ -145,7 +169,8 @@ class ThroughputTimer:
         self.micro_step_count += 1
         self.global_step_count += 1
         if self.start_time > 0:
-            _device_synchronize()
+            if self.sync:
+                _device_synchronize()
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
@@ -161,4 +186,4 @@ class ThroughputTimer:
         if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
             samples = (self.global_step_count - self.start_step) * self.batch_size
             return samples / self.total_elapsed_time
-        return float("-1")
+        return 0.0  # not yet past warm-up: no measurement, not a sentinel

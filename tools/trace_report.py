@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Summarize a Chrome trace-event file into a per-span time breakdown.
+
+The artifact perf PRs cite: feed it the trace the engine's step tracer
+writes (``telemetry.trace``; docs/OBSERVABILITY.md) and get a table of
+where step time goes — total / count / mean / p50 / p99 / share per span
+name — plus counter summaries (e.g. ``telemetry/recompiles``) and instant
+events (retrace markers).
+
+Standalone on purpose: imports nothing beyond the stdlib, so it runs
+anywhere a trace file lands (including hosts without jax installed).
+
+Usage:
+    python tools/trace_report.py TRACE.json [--sort total|mean|count]
+    python tools/trace_report.py --selftest
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents", [])
+    elif isinstance(doc, list):  # bare-array Chrome trace variant
+        events = doc
+    else:
+        raise ValueError(f"{path}: not a Chrome trace (dict or list)")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents is not a list")
+    return events
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo)
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    spans: Dict[str, List[float]] = {}
+    counters: Dict[str, float] = {}
+    instants: Dict[str, int] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        name = ev.get("name", "<unnamed>")
+        if ph == "X":
+            spans.setdefault(name, []).append(float(ev.get("dur", 0.0)))
+        elif ph == "C":
+            args = ev.get("args") or {}
+            # last write wins: counters carry running totals
+            for k, v in args.items():
+                counters[name if k == "value" else f"{name}.{k}"] = float(v)
+        elif ph == "i" or ph == "I":
+            instants[name] = instants.get(name, 0) + 1
+    rows = []
+    for name, durs in spans.items():
+        durs.sort()
+        total = sum(durs)
+        rows.append({
+            "name": name,
+            "count": len(durs),
+            "total_ms": total / 1e3,
+            "mean_ms": total / len(durs) / 1e3,
+            "p50_ms": _percentile(durs, 50) / 1e3,
+            "p99_ms": _percentile(durs, 99) / 1e3,
+        })
+    grand = sum(r["total_ms"] for r in rows) or 1.0
+    for r in rows:
+        r["share"] = r["total_ms"] / grand
+    return {"spans": rows, "counters": counters, "instants": instants}
+
+
+def render(summary: Dict[str, Any], sort: str = "total") -> str:
+    key = {"total": "total_ms", "mean": "mean_ms", "count": "count"}[sort]
+    rows = sorted(summary["spans"], key=lambda r: r[key], reverse=True)
+    out = []
+    hdr = (f"{'span':<24} {'count':>7} {'total ms':>12} {'mean ms':>10} "
+           f"{'p50 ms':>10} {'p99 ms':>10} {'share':>7}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        out.append(f"{r['name']:<24} {r['count']:>7} {r['total_ms']:>12.3f} "
+                   f"{r['mean_ms']:>10.3f} {r['p50_ms']:>10.3f} "
+                   f"{r['p99_ms']:>10.3f} {r['share']:>6.1%}")
+    if not rows:
+        out.append("(no complete spans in trace)")
+    if summary["counters"]:
+        out.append("")
+        out.append("counters (latest value):")
+        for name, v in sorted(summary["counters"].items()):
+            out.append(f"  {name}: {v:g}")
+    if summary["instants"]:
+        out.append("")
+        out.append("instant events:")
+        for name, n in sorted(summary["instants"].items()):
+            out.append(f"  {name}: x{n}")
+    return "\n".join(out)
+
+
+def _selftest() -> int:
+    """Synthesize a trace, run the full load→summarize→render path, and
+    verify the numbers — exercised from the test suite and CI."""
+    events = []
+    # 3 steps of a synthetic loop: dataloader 1ms, forward 4ms, backward
+    # 0.01ms, optimizer_step 2ms; one ckpt pair; one recompile marker.
+    t = 0.0
+    for step in range(3):
+        for name, dur_ms in (("dataloader", 1.0), ("forward", 4.0),
+                             ("backward", 0.01), ("optimizer_step", 2.0)):
+            events.append({"name": name, "ph": "X", "pid": 1, "tid": 1,
+                           "ts": t, "dur": dur_ms * 1e3,
+                           "args": {"step": step}})
+            t += dur_ms * 1e3
+    events.append({"name": "ckpt_snapshot", "ph": "X", "pid": 1, "tid": 2,
+                   "ts": t, "dur": 500.0})
+    events.append({"name": "ckpt_write", "ph": "X", "pid": 1, "tid": 2,
+                   "ts": t + 500.0, "dur": 1500.0})
+    events.append({"name": "recompile", "ph": "i", "s": "t", "pid": 1,
+                   "tid": 1, "ts": t, "args": {"fn": "train_step"}})
+    events.append({"name": "telemetry/recompiles", "ph": "C", "pid": 1,
+                   "tid": 1, "ts": t, "args": {"value": 1.0}})
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "trace.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        summary = summarize(load_events(path))
+        text = render(summary)
+    by_name = {r["name"]: r for r in summary["spans"]}
+    assert len(by_name) == 6, by_name.keys()
+    assert by_name["forward"]["count"] == 3
+    assert abs(by_name["forward"]["total_ms"] - 12.0) < 1e-9
+    assert abs(by_name["optimizer_step"]["mean_ms"] - 2.0) < 1e-9
+    assert summary["counters"]["telemetry/recompiles"] == 1.0
+    assert summary["instants"]["recompile"] == 1
+    assert "forward" in text and "share" in text
+    top = max(summary["spans"], key=lambda r: r["total_ms"])
+    assert top["name"] == "forward"
+    print(text)
+    print("\nselftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", nargs="?", help="Chrome trace-event JSON file")
+    ap.add_argument("--sort", choices=("total", "mean", "count"),
+                    default="total")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in round-trip check and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.trace:
+        ap.error("trace file required (or --selftest)")
+    summary = summarize(load_events(args.trace))
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(render(summary, sort=args.sort))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
