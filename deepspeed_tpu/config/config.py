@@ -190,6 +190,168 @@ class ResilienceConfig:
 
 
 @dataclass
+class GuardrailsDetectorConfig:
+    """Anomaly-detector knobs (guardrails/detector.py)."""
+
+    zscore_threshold: float = C.GUARDRAILS_DET_ZSCORE_DEFAULT
+    warmup_steps: int = C.GUARDRAILS_DET_WARMUP_DEFAULT
+    ewma_alpha: float = C.GUARDRAILS_DET_EWMA_ALPHA_DEFAULT
+    track_grad_norm: bool = C.GUARDRAILS_DET_TRACK_GRAD_NORM_DEFAULT
+    # In-device skip-on-nonfinite-grads for bf16/fp32 runs (the fp16 path
+    # already has the loss-scaler skip). Default OFF: the predicate rides
+    # inside the jitted step, so the gate must be an explicit opt-in.
+    check_nonfinite_grads: bool = C.GUARDRAILS_DET_NONFINITE_GRADS_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "GuardrailsDetectorConfig":
+        d = d or {}
+        cfg = cls(
+            zscore_threshold=float(_get(d, C.GUARDRAILS_DET_ZSCORE,
+                                        C.GUARDRAILS_DET_ZSCORE_DEFAULT)),
+            warmup_steps=int(_get(d, C.GUARDRAILS_DET_WARMUP,
+                                  C.GUARDRAILS_DET_WARMUP_DEFAULT)),
+            ewma_alpha=float(_get(d, C.GUARDRAILS_DET_EWMA_ALPHA,
+                                  C.GUARDRAILS_DET_EWMA_ALPHA_DEFAULT)),
+            track_grad_norm=bool(_get(d, C.GUARDRAILS_DET_TRACK_GRAD_NORM,
+                                      C.GUARDRAILS_DET_TRACK_GRAD_NORM_DEFAULT)),
+            check_nonfinite_grads=bool(
+                _get(d, C.GUARDRAILS_DET_NONFINITE_GRADS,
+                     C.GUARDRAILS_DET_NONFINITE_GRADS_DEFAULT)),
+        )
+        if cfg.zscore_threshold <= 0:
+            raise ConfigError("guardrails.detector.zscore_threshold must be > 0")
+        if cfg.warmup_steps < 1:
+            raise ConfigError("guardrails.detector.warmup_steps must be >= 1")
+        if not 0.0 < cfg.ewma_alpha <= 1.0:
+            raise ConfigError("guardrails.detector.ewma_alpha must be in (0, 1]")
+        return cfg
+
+
+@dataclass
+class GuardrailsRollbackConfig:
+    """In-memory rollback knobs (guardrails/rollback.py)."""
+
+    enabled: bool = C.GUARDRAILS_RB_ENABLED_DEFAULT
+    snapshot_interval: int = C.GUARDRAILS_RB_SNAPSHOT_INTERVAL_DEFAULT
+    ring_size: int = C.GUARDRAILS_RB_RING_SIZE_DEFAULT
+    consecutive_spikes: int = C.GUARDRAILS_RB_CONSECUTIVE_SPIKES_DEFAULT
+    skip_batches: int = C.GUARDRAILS_RB_SKIP_BATCHES_DEFAULT
+    lr_decay: float = C.GUARDRAILS_RB_LR_DECAY_DEFAULT
+    max_rollbacks: int = C.GUARDRAILS_RB_MAX_ROLLBACKS_DEFAULT
+    escalate_to_disk: bool = C.GUARDRAILS_RB_ESCALATE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "GuardrailsRollbackConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.GUARDRAILS_RB_ENABLED,
+                              C.GUARDRAILS_RB_ENABLED_DEFAULT)),
+            snapshot_interval=int(_get(d, C.GUARDRAILS_RB_SNAPSHOT_INTERVAL,
+                                       C.GUARDRAILS_RB_SNAPSHOT_INTERVAL_DEFAULT)),
+            ring_size=int(_get(d, C.GUARDRAILS_RB_RING_SIZE,
+                               C.GUARDRAILS_RB_RING_SIZE_DEFAULT)),
+            consecutive_spikes=int(_get(d, C.GUARDRAILS_RB_CONSECUTIVE_SPIKES,
+                                        C.GUARDRAILS_RB_CONSECUTIVE_SPIKES_DEFAULT)),
+            skip_batches=int(_get(d, C.GUARDRAILS_RB_SKIP_BATCHES,
+                                  C.GUARDRAILS_RB_SKIP_BATCHES_DEFAULT)),
+            lr_decay=float(_get(d, C.GUARDRAILS_RB_LR_DECAY,
+                                C.GUARDRAILS_RB_LR_DECAY_DEFAULT)),
+            max_rollbacks=int(_get(d, C.GUARDRAILS_RB_MAX_ROLLBACKS,
+                                   C.GUARDRAILS_RB_MAX_ROLLBACKS_DEFAULT)),
+            escalate_to_disk=bool(_get(d, C.GUARDRAILS_RB_ESCALATE,
+                                       C.GUARDRAILS_RB_ESCALATE_DEFAULT)),
+        )
+        if cfg.snapshot_interval < 1:
+            raise ConfigError("guardrails.rollback.snapshot_interval must be >= 1")
+        if cfg.ring_size < 1:
+            raise ConfigError("guardrails.rollback.ring_size must be >= 1")
+        if cfg.consecutive_spikes < 1:
+            raise ConfigError("guardrails.rollback.consecutive_spikes must be >= 1")
+        if cfg.skip_batches < 0:
+            raise ConfigError("guardrails.rollback.skip_batches must be >= 0")
+        if not 0.0 < cfg.lr_decay <= 1.0:
+            raise ConfigError("guardrails.rollback.lr_decay must be in (0, 1]")
+        if cfg.max_rollbacks < 1:
+            raise ConfigError("guardrails.rollback.max_rollbacks must be >= 1")
+        return cfg
+
+
+@dataclass
+class GuardrailsWatchdogConfig:
+    """Step-deadline watchdog knobs (guardrails/watchdog.py)."""
+
+    enabled: bool = C.GUARDRAILS_WD_ENABLED_DEFAULT
+    step_timeout_seconds: float = C.GUARDRAILS_WD_TIMEOUT_DEFAULT
+    poll_interval_seconds: Optional[float] = None
+    crashdump_dir: str = C.GUARDRAILS_WD_CRASHDUMP_DIR_DEFAULT
+    exit_code: int = C.GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "GuardrailsWatchdogConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.GUARDRAILS_WD_ENABLED,
+                              C.GUARDRAILS_WD_ENABLED_DEFAULT)),
+            step_timeout_seconds=float(_get(d, C.GUARDRAILS_WD_TIMEOUT,
+                                            C.GUARDRAILS_WD_TIMEOUT_DEFAULT)),
+            poll_interval_seconds=(
+                float(d[C.GUARDRAILS_WD_POLL])
+                if d.get(C.GUARDRAILS_WD_POLL) is not None else None),
+            crashdump_dir=str(_get(d, C.GUARDRAILS_WD_CRASHDUMP_DIR,
+                                   C.GUARDRAILS_WD_CRASHDUMP_DIR_DEFAULT)),
+            exit_code=int(_get(d, C.GUARDRAILS_WD_EXIT_CODE,
+                               C.GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT)),
+        )
+        if cfg.enabled and cfg.step_timeout_seconds <= 0:
+            raise ConfigError(
+                "guardrails.watchdog.step_timeout_seconds must be > 0")
+        if (cfg.poll_interval_seconds is not None
+                and float(cfg.poll_interval_seconds) <= 0):
+            raise ConfigError(
+                "guardrails.watchdog.poll_interval_seconds must be > 0 "
+                "(a non-positive poll busy-spins the watchdog thread)")
+        if not 0 < cfg.exit_code < 256:
+            raise ConfigError("guardrails.watchdog.exit_code must be in 1..255")
+        return cfg
+
+
+@dataclass
+class GuardrailsConfig:
+    """Unattended-training guardrails (guardrails/; docs/RESILIENCE.md
+    "Guardrails"): EWMA/z-score anomaly detection over loss + grad norm,
+    in-memory rollback from a bounded snapshot ring, and a step-deadline
+    watchdog. Disabled (the default) the engine takes the exact pre-
+    guardrails step path: no host fetches, no device syncs, no snapshots."""
+
+    enabled: bool = False
+    detector: GuardrailsDetectorConfig = field(
+        default_factory=GuardrailsDetectorConfig)
+    rollback: GuardrailsRollbackConfig = field(
+        default_factory=GuardrailsRollbackConfig)
+    watchdog: GuardrailsWatchdogConfig = field(
+        default_factory=GuardrailsWatchdogConfig)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "GuardrailsConfig":
+        d = d or {}
+        return cls(
+            enabled=bool(_get(d, C.GUARDRAILS_ENABLED, False)),
+            detector=GuardrailsDetectorConfig.from_dict(
+                d.get(C.GUARDRAILS_DETECTOR)),
+            rollback=GuardrailsRollbackConfig.from_dict(
+                d.get(C.GUARDRAILS_ROLLBACK)),
+            watchdog=GuardrailsWatchdogConfig.from_dict(
+                d.get(C.GUARDRAILS_WATCHDOG)),
+        )
+
+    @property
+    def nonfinite_grad_check(self) -> bool:
+        """The jitted-step gate: bf16/fp32 skip-on-nonfinite is active only
+        when guardrails are on AND the detector opted in."""
+        return self.enabled and self.detector.check_nonfinite_grads
+
+
+@dataclass
 class MeshConfig:
     """Named parallel axes. Sizes of 1 mean the axis is unused.
 
@@ -446,6 +608,7 @@ class DeepSpeedTPUConfig:
         self.tensorboard = TensorboardConfig.from_dict(d.get(C.TENSORBOARD))
         self.telemetry = TelemetryConfig.from_dict(d.get(C.TELEMETRY))
         self.resilience = ResilienceConfig.from_dict(d.get(C.RESILIENCE))
+        self.guardrails = GuardrailsConfig.from_dict(d.get(C.GUARDRAILS))
         self.sparse_attention = d.get(C.SPARSE_ATTENTION)
         self.pipeline = dict(d.get(C.PIPELINE, {}))
         self.eigenvalue = dict(d.get(C.EIGENVALUE, {}))

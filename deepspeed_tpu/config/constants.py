@@ -99,6 +99,55 @@ RESILIENCE_AUTO_RESUME_DEFAULT = True
 RESILIENCE_FAULT_INJECTION = "fault_injection"
 
 #############################################
+# Guardrails (TPU-native block, no reference analogue beyond the fp16
+# CheckOverflow path: anomaly detection + in-memory rollback + step
+# watchdog, guardrails/; docs/RESILIENCE.md "Guardrails")
+#############################################
+GUARDRAILS = "guardrails"
+GUARDRAILS_ENABLED = "enabled"
+GUARDRAILS_DETECTOR = "detector"
+GUARDRAILS_DET_ZSCORE = "zscore_threshold"
+GUARDRAILS_DET_ZSCORE_DEFAULT = 6.0
+GUARDRAILS_DET_WARMUP = "warmup_steps"
+GUARDRAILS_DET_WARMUP_DEFAULT = 20
+GUARDRAILS_DET_EWMA_ALPHA = "ewma_alpha"
+GUARDRAILS_DET_EWMA_ALPHA_DEFAULT = 0.02
+GUARDRAILS_DET_TRACK_GRAD_NORM = "track_grad_norm"
+GUARDRAILS_DET_TRACK_GRAD_NORM_DEFAULT = True
+GUARDRAILS_DET_NONFINITE_GRADS = "check_nonfinite_grads"
+GUARDRAILS_DET_NONFINITE_GRADS_DEFAULT = False
+GUARDRAILS_ROLLBACK = "rollback"
+GUARDRAILS_RB_ENABLED = "enabled"
+GUARDRAILS_RB_ENABLED_DEFAULT = True
+GUARDRAILS_RB_SNAPSHOT_INTERVAL = "snapshot_interval"
+GUARDRAILS_RB_SNAPSHOT_INTERVAL_DEFAULT = 10
+GUARDRAILS_RB_RING_SIZE = "ring_size"
+GUARDRAILS_RB_RING_SIZE_DEFAULT = 2
+GUARDRAILS_RB_CONSECUTIVE_SPIKES = "consecutive_spikes"
+GUARDRAILS_RB_CONSECUTIVE_SPIKES_DEFAULT = 2
+GUARDRAILS_RB_SKIP_BATCHES = "skip_batches"
+GUARDRAILS_RB_SKIP_BATCHES_DEFAULT = 2
+GUARDRAILS_RB_LR_DECAY = "lr_decay"
+GUARDRAILS_RB_LR_DECAY_DEFAULT = 1.0
+GUARDRAILS_RB_MAX_ROLLBACKS = "max_rollbacks"
+GUARDRAILS_RB_MAX_ROLLBACKS_DEFAULT = 3
+GUARDRAILS_RB_ESCALATE = "escalate_to_disk"
+GUARDRAILS_RB_ESCALATE_DEFAULT = True
+GUARDRAILS_WATCHDOG = "watchdog"
+GUARDRAILS_WD_ENABLED = "enabled"
+GUARDRAILS_WD_ENABLED_DEFAULT = False
+GUARDRAILS_WD_TIMEOUT = "step_timeout_seconds"
+GUARDRAILS_WD_TIMEOUT_DEFAULT = 1800.0
+GUARDRAILS_WD_POLL = "poll_interval_seconds"
+GUARDRAILS_WD_CRASHDUMP_DIR = "crashdump_dir"
+GUARDRAILS_WD_CRASHDUMP_DIR_DEFAULT = "crashdumps"
+GUARDRAILS_WD_EXIT_CODE = "exit_code"
+# Distinct from everything the runtime otherwise produces (1 generic, 2
+# pytest/usage, 137/139/143 signal deaths): the supervisor maps THIS rc to
+# an immediate no-backoff restart — a hang already burned its budget.
+GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT = 113
+
+#############################################
 # Telemetry (TPU-native block, no reference analogue: unified metrics
 # registry + step tracer + recompilation detector, telemetry/)
 #############################################

@@ -1,0 +1,202 @@
+"""Training guardrails: anomaly detection, in-memory rollback, and a step
+watchdog for unattended runs (docs/RESILIENCE.md "Guardrails").
+
+The resilience tier (PR 1) survives process *death*; telemetry (PR 2) makes
+the run *observable*. This subsystem closes the remaining gap for
+unattended training — the run that neither dies nor behaves:
+
+- :class:`~deepspeed_tpu.guardrails.detector.AnomalyDetector` — EWMA/
+  z-score classification of every step's (loss, global grad norm) into
+  ok / skip / spike, plus a nonfinite check that works in bf16 (where the
+  engine has no loss-scaler overflow path);
+- :class:`~deepspeed_tpu.guardrails.rollback.RollbackPolicy` over a
+  :class:`~deepspeed_tpu.guardrails.rollback.SnapshotRing` — restore the
+  last good in-memory state after N consecutive spikes, advance the data
+  stream past the offending window, optionally decay the LR, escalate to
+  the on-disk resilience checkpoint when the ring is empty;
+- :class:`~deepspeed_tpu.guardrails.watchdog.StepWatchdog` — a hung step
+  (deadlocked collective, stuck host callback) dumps diagnostics and exits
+  with a distinct rc that the supervisor maps to an immediate restart;
+- :mod:`~deepspeed_tpu.guardrails.retry` — the shared jittered-exponential
+  backoff used by the checkpoint writer, distributed init and supervisor.
+
+Cost contract: ``build_guardrails`` returns ``None`` for a disabled block
+and every engine hook is behind an ``is None`` check — a guardrails-off run
+performs zero added host fetches, zero device syncs, zero snapshots
+(asserted by tests/test_guardrails.py the same way the telemetry zero-sync
+test does). Enabled, the per-step cost is two scalar host fetches plus an
+amortised ring snapshot every ``snapshot_interval`` steps.
+"""
+
+from typing import Any, Callable, Optional
+
+from deepspeed_tpu.guardrails.detector import (OK, SKIP, SPIKE,
+                                               AnomalyDetector, EWMATracker,
+                                               Verdict)
+from deepspeed_tpu.guardrails.retry import backoff_delay, retry_call
+from deepspeed_tpu.guardrails.rollback import (GuardrailsError,
+                                               RollbackPolicy, SnapshotRing,
+                                               restore_snapshot,
+                                               take_snapshot)
+from deepspeed_tpu.guardrails.watchdog import StepWatchdog, is_watchdog_exit
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = [
+    "OK", "SKIP", "SPIKE", "AnomalyDetector", "EWMATracker", "Verdict",
+    "backoff_delay", "retry_call", "GuardrailsError", "RollbackPolicy",
+    "SnapshotRing", "restore_snapshot", "take_snapshot", "StepWatchdog",
+    "is_watchdog_exit", "Guardrails", "build_guardrails",
+]
+
+
+def _host_fetch(x) -> float:
+    """THE device->host scalar fetch of this subsystem. Single site so the
+    zero-cost-when-disabled test can count every guardrails-originated
+    device sync by patching one name."""
+    return float(x)
+
+
+def _finite(z: float, cap: float = 1e9) -> float:
+    """Clamp a z-score for metric emission (inf is not JSON)."""
+    return max(-cap, min(cap, z))
+
+
+class Guardrails:
+    """Per-engine facade wiring detector + rollback + watchdog together.
+
+    The engine owns exactly three call sites: ``step_begin``/``step_end``
+    bracketing the step (watchdog deadline) and ``after_step`` with the
+    step's (loss, overflow, grad-norm) device scalars (detector + policy).
+    """
+
+    def __init__(self, cfg, telemetry=None, metrics_path: Optional[str] = None):
+        self.cfg = cfg
+        self.telemetry = telemetry
+        self.detector = AnomalyDetector(
+            zscore_threshold=cfg.detector.zscore_threshold,
+            warmup_steps=cfg.detector.warmup_steps,
+            ewma_alpha=cfg.detector.ewma_alpha,
+            track_grad_norm=cfg.detector.track_grad_norm)
+        self.ring: Optional[SnapshotRing] = None
+        self.policy: Optional[RollbackPolicy] = None
+        if cfg.rollback.enabled:
+            self.ring = SnapshotRing(cfg.rollback.ring_size)
+            self.policy = RollbackPolicy(
+                self.ring,
+                consecutive_spikes=cfg.rollback.consecutive_spikes,
+                skip_batches=cfg.rollback.skip_batches,
+                lr_decay=cfg.rollback.lr_decay,
+                max_rollbacks=cfg.rollback.max_rollbacks,
+                escalate_to_disk=cfg.rollback.escalate_to_disk)
+        self.watchdog: Optional[StepWatchdog] = None
+        if cfg.watchdog.enabled:
+            self.watchdog = StepWatchdog(
+                timeout=cfg.watchdog.step_timeout_seconds,
+                crashdump_dir=cfg.watchdog.crashdump_dir,
+                exit_code=cfg.watchdog.exit_code,
+                poll_interval=cfg.watchdog.poll_interval_seconds,
+                telemetry=telemetry,
+                metrics_tail_of=metrics_path).start()
+        self._data_skip_fn: Optional[Callable[[int], None]] = None
+        self.last_verdict: Optional[Verdict] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def lr_scale(self) -> float:
+        return self.policy.lr_scale if self.policy is not None else 1.0
+
+    def register_data_skip_fn(self, fn: Callable[[int], None]) -> None:
+        self._data_skip_fn = fn
+
+    def step_begin(self, step: int, label: str = "train_step") -> None:
+        if self.watchdog is not None:
+            self.watchdog.step_begin(step, label)
+
+    def step_end(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.step_end()
+
+    def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+
+    # ------------------------------------------------------------------
+    def after_step(self, engine, loss: Any, overflow: Any,
+                   norm: Any = None) -> bool:
+        """Feed one committed step's scalars through detection + policy.
+        Returns True when a rollback rewound the engine (the caller then
+        skips its own fail-fast numerics check for this step)."""
+        step = int(engine.global_steps)
+        of = bool(_host_fetch(overflow)) if overflow is not None else False
+        lossf = _host_fetch(loss)
+        normf = _host_fetch(norm) if norm is not None else None
+        verdict = self.detector.observe(step, lossf, grad_norm=normf,
+                                        overflow=of)
+        self.last_verdict = verdict
+        self._emit(step, verdict)
+        if verdict.kind == SPIKE:
+            logger.warning(
+                "guardrails: spike verdict at step %d (%s: loss=%.6g "
+                "loss_z=%.3g norm_z=%.3g, streak %d/%s)", step,
+                verdict.reason, lossf, verdict.loss_z, verdict.norm_z,
+                (self.policy.spike_streak + 1) if self.policy else 1,
+                self.policy.consecutive_spikes if self.policy else "-")
+            if self.policy is not None and self.policy.note_spike():
+                # Recovery is not a step: a disk-escalation restore or a
+                # long loader skip must not trip the step deadline and
+                # convert a cheap rollback into a watchdog kill.
+                if self.watchdog is not None:
+                    self.watchdog.suspend()
+                summary = self.policy.rollback(engine, self._data_skip_fn)
+                self._emit_rollback(step, summary)
+                return True
+        elif verdict.kind == OK:
+            if self.policy is not None:
+                self.policy.note_ok()
+            # Prime the ring at the FIRST ok step (a spike before the first
+            # interval boundary would otherwise find it empty), then refresh
+            # every snapshot_interval steps.
+            if self.ring is not None and (
+                    len(self.ring) == 0
+                    or step % self.cfg.rollback.snapshot_interval == 0):
+                self.ring.push(take_snapshot(engine))
+                self._counter("guardrails/snapshots", step)
+        return False
+
+    # ------------------------------------------------------------------
+    def _emit(self, step: int, verdict: Verdict) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        reg = tel.registry
+        reg.counter(f"guardrails/steps_{verdict.kind}").inc(step=step)
+        reg.gauge("guardrails/loss_zscore").set(_finite(verdict.loss_z),
+                                                step=step)
+        if verdict.norm_z:
+            reg.gauge("guardrails/grad_norm_zscore").set(
+                _finite(verdict.norm_z), step=step)
+        if verdict.kind == SPIKE:
+            tel.instant("guardrails_spike", step=step, reason=verdict.reason,
+                        loss_z=_finite(verdict.loss_z))
+
+    def _emit_rollback(self, step: int, summary: dict) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        tel.registry.counter("guardrails/rollbacks").inc(step=step)
+        tel.instant("guardrails_rollback", step=step, **{
+            k: v for k, v in summary.items() if v is not None})
+
+    def _counter(self, name: str, step: int) -> None:
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            tel.registry.counter(name).inc(step=step)
+
+
+def build_guardrails(gcfg, telemetry=None,
+                     metrics_path: Optional[str] = None) -> Optional[Guardrails]:
+    """``None`` for a disabled block — the engine's hooks gate on ``is
+    None``, which is the whole zero-cost-when-disabled story."""
+    if gcfg is None or not gcfg.enabled:
+        return None
+    return Guardrails(gcfg, telemetry=telemetry, metrics_path=metrics_path)

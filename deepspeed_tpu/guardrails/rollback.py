@@ -1,0 +1,220 @@
+"""In-memory rollback: a bounded ring of last-good state snapshots plus the
+policy that decides when to restore one.
+
+The resilience tier (``resilience/checkpoint.py``) already knows how to
+snapshot an engine to host RAM and how to place saved arrays back onto the
+engine's shardings — that machinery is reused wholesale here. The delta is
+*where* the snapshot lives (a host-RAM ring, never disk) and *why* it is
+restored (a numeric anomaly, not a process death): recovering from a NaN
+spike via the on-disk path costs a full deserialize + reshard and loses up
+to ``checkpoint.interval`` steps; the in-memory ring restores in one
+device_put sweep and loses only the steps since the last ring push.
+
+Policy (:class:`RollbackPolicy`): after ``consecutive_spikes`` spike
+verdicts in a row, restore the newest ring snapshot, ask the data pipeline
+to skip ``skip_batches`` batches (the poisoned window — batches consumed
+since the snapshot are already behind the loader and are dropped by
+construction), optionally decay the LR, and count the rollback against
+``max_rollbacks``. With the ring empty the policy escalates to the newest
+on-disk resilience checkpoint; with nothing anywhere it raises — training
+on known-poisoned state is the one thing guardrails exist to prevent.
+"""
+
+import collections
+from typing import Any, Callable, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class GuardrailsError(RuntimeError):
+    """Anomaly detected and no recovery path remains."""
+
+
+class SnapshotRing:
+    """Bounded ring of host-side engine snapshots (newest wins).
+
+    Entries are the resilience tier's ``_Snapshot`` objects
+    (:func:`deepspeed_tpu.resilience.snapshot_engine`) — host numpy copies
+    of the full TrainState plus step/scheduler metadata, exactly what an
+    in-memory restore needs. Memory is bounded by ``capacity`` full state
+    copies; size the ring against host RAM, not ambition (2 is plenty: one
+    known-good state plus one older fallback).
+    """
+
+    def __init__(self, capacity: int = 2):
+        if capacity < 1:
+            raise ValueError("snapshot ring capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._ring = collections.deque(maxlen=self.capacity)
+        self.pushes = 0
+
+    def push(self, snap: Any) -> None:
+        self._ring.append(snap)
+        self.pushes += 1
+
+    def newest(self) -> Optional[Any]:
+        return self._ring[-1] if self._ring else None
+
+    def drop_newest(self) -> None:
+        """Discard the newest snapshot (it proved bad: restoring it did not
+        stop the spikes, so the next rollback should reach further back)."""
+        if self._ring:
+            self._ring.pop()
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+def _params_finite(engine) -> bool:
+    """One host fetch over a stacked per-leaf isfinite reduction — cheap
+    relative to the disk restore it sanity-checks."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [x for x in jax.tree_util.tree_leaves(engine.state.params)
+              if hasattr(x, "dtype")
+              and jnp.issubdtype(x.dtype, jnp.inexact)]
+    if not leaves:
+        return True
+    flags = jax.jit(lambda ls: jnp.stack(
+        [jnp.all(jnp.isfinite(x)) for x in ls]))(leaves)
+    return bool(jnp.all(flags))
+
+
+def take_snapshot(engine) -> Any:
+    """Host snapshot of the engine's full training state (reuses the
+    resilience D2H machinery; no disk I/O)."""
+    from deepspeed_tpu.resilience.checkpoint import snapshot_engine
+
+    return snapshot_engine(engine)
+
+
+def restore_snapshot(engine, snap) -> int:
+    """Install a ring snapshot back onto the engine (device placement via
+    the resilience restore path). Returns the number of optimizer steps
+    rewound."""
+    from deepspeed_tpu.resilience.checkpoint import install_state_arrays
+
+    before = int(engine.global_steps)
+    install_state_arrays(engine, dict(snap.arrays),
+                         step=int(snap.meta["step"]),
+                         micro_steps=int(snap.meta["micro_steps"]),
+                         lr_scheduler_state=snap.meta.get("lr_scheduler"))
+    return before - int(engine.global_steps)
+
+
+class RollbackPolicy:
+    """Spike-streak bookkeeping + the rollback act itself."""
+
+    def __init__(self,
+                 ring: SnapshotRing,
+                 consecutive_spikes: int = 2,
+                 skip_batches: int = 2,
+                 lr_decay: float = 1.0,
+                 max_rollbacks: int = 3,
+                 escalate_to_disk: bool = True):
+        if consecutive_spikes < 1:
+            raise ValueError("consecutive_spikes must be >= 1")
+        if skip_batches < 0:
+            raise ValueError("skip_batches must be >= 0")
+        if not 0.0 < lr_decay <= 1.0:
+            raise ValueError("lr_decay must be in (0, 1]")
+        if max_rollbacks < 1:
+            raise ValueError("max_rollbacks must be >= 1")
+        self.ring = ring
+        self.consecutive_spikes = int(consecutive_spikes)
+        self.skip_batches = int(skip_batches)
+        self.lr_decay = float(lr_decay)
+        self.max_rollbacks = int(max_rollbacks)
+        self.escalate_to_disk = bool(escalate_to_disk)
+        self.spike_streak = 0
+        self.rollbacks = 0
+        self.lr_scale = 1.0
+
+    # ------------------------------------------------------------------
+    def note_ok(self) -> None:
+        self.spike_streak = 0
+
+    def note_spike(self) -> bool:
+        """Record one spike verdict; True when the streak crossed the
+        rollback threshold (the caller then invokes :meth:`rollback`)."""
+        self.spike_streak += 1
+        return self.spike_streak >= self.consecutive_spikes
+
+    # ------------------------------------------------------------------
+    def rollback(self, engine,
+                 data_skip_fn: Optional[Callable[[int], None]] = None) -> dict:
+        """Restore the last good state and move the data stream past the
+        offending window. Returns a summary dict for telemetry/logs."""
+        if self.rollbacks >= self.max_rollbacks:
+            raise GuardrailsError(
+                f"guardrails: rollback budget exhausted "
+                f"({self.max_rollbacks}) and loss is still spiking at step "
+                f"{engine.global_steps} — the instability is not transient; "
+                "aborting rather than training on poisoned state")
+        self.rollbacks += 1
+        self.spike_streak = 0
+        snap = self.ring.newest()
+        summary = {"rollbacks": self.rollbacks, "skipped_batches": 0,
+                   "steps_rewound": 0, "source": None}
+        if snap is not None:
+            steps_rewound = restore_snapshot(engine, snap)
+            # A re-triggered rollback should not restore this same snapshot
+            # again (its trajectory just spiked); fall back one deeper.
+            self.ring.drop_newest()
+            summary.update(source="memory", steps_rewound=steps_rewound,
+                           restored_step=int(engine.global_steps))
+        elif self.escalate_to_disk and self._disk_dir(engine):
+            from deepspeed_tpu.resilience import restore
+
+            path, _ = restore(engine, self._disk_dir(engine))
+            if path is None:
+                raise GuardrailsError(
+                    "guardrails: spike streak with no in-memory snapshot "
+                    "and no complete on-disk checkpoint to escalate to")
+            # Digest-valid is not numerics-valid: the engine skips interval
+            # saves on spike verdicts, but a checkpoint written before
+            # guardrails were enabled (or by an older build) could still
+            # hold non-finite params — restoring it would burn the whole
+            # rollback budget re-spiking. Fail loudly instead.
+            if not _params_finite(engine):
+                raise GuardrailsError(
+                    f"guardrails: escalated to on-disk checkpoint {path} "
+                    "but its params are non-finite — the newest complete "
+                    "checkpoint is itself poisoned; restore an older one "
+                    "manually")
+            summary.update(source="disk", path=path,
+                           restored_step=int(engine.global_steps))
+        else:
+            raise GuardrailsError(
+                "guardrails: spike streak with no in-memory snapshot and "
+                "disk escalation unavailable (enable resilience "
+                "checkpointing or increase guardrails.rollback.ring_size)")
+        if self.lr_decay < 1.0:
+            self.lr_scale *= self.lr_decay
+            summary["lr_scale"] = self.lr_scale
+        if data_skip_fn is not None and self.skip_batches:
+            data_skip_fn(self.skip_batches)
+            summary["skipped_batches"] = self.skip_batches
+        elif self.skip_batches:
+            logger.warning(
+                "guardrails: no data-skip callback registered "
+                "(engine.register_data_skip_fn) — the loader will replay "
+                "from its current position; if the anomaly is data-borne "
+                "the same window may spike again")
+        logger.warning("guardrails: rolled back to step %s from %s "
+                       "(rollback %d/%d, skipped %d batches)",
+                       summary.get("restored_step"), summary["source"],
+                       self.rollbacks, self.max_rollbacks,
+                       summary["skipped_batches"])
+        return summary
+
+    @staticmethod
+    def _disk_dir(engine) -> str:
+        rcfg = getattr(engine.config, "resilience", None)
+        if rcfg is not None and rcfg.enabled:
+            return rcfg.checkpoint.dir
+        return ""

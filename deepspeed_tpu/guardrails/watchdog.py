@@ -1,0 +1,210 @@
+"""Step watchdog: detect a hung training step and die loudly, with evidence.
+
+On a TPU pod the nastiest failure is not a crash but a *hang*: one worker
+stalls in a collective (peer died mid-allreduce, DCN link flap, a stuck
+host callback) and every other worker blocks with it — forever, burning
+the reservation, while the supervisor sees a perfectly alive process. The
+watchdog turns that silence into a distinct, restartable death:
+
+- the engine brackets every step with :meth:`step_begin` / :meth:`step_end`;
+- a daemon thread checks, at ``poll_interval``, whether an *armed* step has
+  exceeded ``timeout`` (idle time between steps never counts — eval pauses
+  and dataset stalls are not hangs);
+- on trip it dumps diagnostics to a crashdump dir — faulthandler stacks of
+  every thread (the hung collective's frame included), the recent telemetry
+  trace events, and the tail of the metrics JSONL — then exits the process
+  with a **distinct** exit code (:data:`~deepspeed_tpu.config.constants.
+  GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT`), which the resilience supervisor
+  maps to an immediate (no-backoff) restart + auto-resume.
+
+``os._exit`` on purpose: a hung step cannot be unwound by exceptions (the
+main thread is blocked inside a device wait), and atexit handlers may
+themselves be the hung parties. The crashdump is flushed first; the
+process must *go*.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from deepspeed_tpu.config.constants import \
+    GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT
+from deepspeed_tpu.utils.logging import logger
+
+
+class StepWatchdog:
+    """Deadline monitor for the training step. One per engine."""
+
+    def __init__(self,
+                 timeout: float,
+                 crashdump_dir: str = "crashdumps",
+                 exit_code: int = GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT,
+                 poll_interval: Optional[float] = None,
+                 telemetry=None,
+                 metrics_tail_of: Optional[str] = None,
+                 exit_fn: Callable[[int], None] = os._exit):
+        if timeout <= 0:
+            raise ValueError("watchdog timeout must be > 0 seconds")
+        if poll_interval is not None and poll_interval <= 0:
+            raise ValueError("watchdog poll_interval must be > 0 seconds "
+                             "(non-positive would busy-spin the thread)")
+        self.timeout = float(timeout)
+        self.crashdump_dir = crashdump_dir
+        self.exit_code = int(exit_code)
+        self.poll_interval = (float(poll_interval) if poll_interval
+                              else max(0.05, min(1.0, self.timeout / 4.0)))
+        self.telemetry = telemetry
+        self.metrics_tail_of = metrics_tail_of
+        self._exit_fn = exit_fn
+        self._lock = threading.Lock()
+        self._armed_at: Optional[float] = None
+        self._depth = 0            # re-entrant: pipe_step wraps train_step
+        self._step = 0
+        self._label = ""
+        self.tripped = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> "StepWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="guardrails-watchdog",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------------------
+    def step_begin(self, step: int, label: str = "train_step") -> None:
+        """Arm the deadline. Re-entrant: only the outermost bracket arms
+        (the pipeline engine wraps the base engine's train_batch)."""
+        with self._lock:
+            self._depth += 1
+            if self._depth == 1:
+                self._armed_at = time.monotonic()
+                self._step = int(step)
+                self._label = label
+
+    def step_end(self) -> None:
+        with self._lock:
+            self._depth = max(0, self._depth - 1)
+            if self._depth == 0:
+                self._armed_at = None
+
+    def suspend(self) -> None:
+        """Fully disarm at ANY bracket depth. Rollback recovery (disk
+        restore, reshard, loader skip) runs inside the step's armed window
+        but is not a step — it must not be killed by the step deadline.
+        The enclosing step_end finallys re-balance harmlessly (depth
+        clamps at 0) and the next step_begin re-arms cleanly."""
+        with self._lock:
+            self._depth = 0
+            self._armed_at = None
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            with self._lock:
+                armed_at, step, label = self._armed_at, self._step, self._label
+            if armed_at is None:
+                continue
+            elapsed = time.monotonic() - armed_at
+            if elapsed > self.timeout:
+                self.trip(step, elapsed, label)
+                return
+
+    def trip(self, step: int, elapsed: float, label: str = "") -> None:
+        """Deadline exceeded: dump diagnostics and exit with the distinct
+        rc. Split out (and ``exit_fn`` injectable) so tests exercise the
+        dump without killing the test process."""
+        self.tripped = True
+        logger.error(
+            "guardrails watchdog: %s for step %d exceeded the %.1fs "
+            "deadline (%.1fs elapsed) — dumping diagnostics and exiting "
+            "rc=%d for supervisor restart", label or "step", step,
+            self.timeout, elapsed, self.exit_code)
+        try:
+            dump = self.dump_diagnostics(step, elapsed, label)
+            logger.error("guardrails watchdog: crashdump at %s", dump)
+        except Exception as e:  # noqa: BLE001 — dying loudly beats dying twice
+            logger.error("guardrails watchdog: diagnostics dump failed: %s", e)
+        self._exit_fn(self.exit_code)
+
+    # ------------------------------------------------------------------
+    def dump_diagnostics(self, step: int, elapsed: float,
+                         label: str = "") -> str:
+        """Write the evidence a post-mortem needs into a fresh directory
+        under ``crashdump_dir``; every artifact is best-effort."""
+        out = os.path.join(self.crashdump_dir,
+                           f"watchdog_step{step}_{os.getpid()}")
+        os.makedirs(out, exist_ok=True)
+        info: dict = {"step": step, "elapsed_sec": round(elapsed, 3),
+                      "timeout_sec": self.timeout, "label": label,
+                      "pid": os.getpid(), "exit_code": self.exit_code}
+
+        # 1. Thread stacks — the hung collective / callback frame.
+        try:
+            import faulthandler
+            with open(os.path.join(out, "stacks.txt"), "w") as f:
+                faulthandler.dump_traceback(file=f, all_threads=True)
+            info["stacks"] = "stacks.txt"
+        except Exception as e:  # noqa: BLE001
+            info["stacks_error"] = repr(e)
+
+        # 2. Recent telemetry trace events (the spans leading into the hang).
+        tel = self.telemetry
+        if tel is not None and getattr(tel, "enabled", False):
+            try:
+                events = tel.tracer.events()[-200:]
+                with open(os.path.join(out, "trace_tail.json"), "w") as f:
+                    json.dump({"traceEvents": events}, f)
+                info["trace_tail"] = "trace_tail.json"
+            except Exception as e:  # noqa: BLE001
+                info["trace_tail_error"] = repr(e)
+
+        # 3. Tail of the metrics JSONL (last scalar lines before the hang).
+        if self.metrics_tail_of and os.path.exists(self.metrics_tail_of):
+            try:
+                with open(self.metrics_tail_of, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    f.seek(max(0, f.tell() - 64 * 1024))
+                    tail = f.read().decode("utf-8", errors="replace")
+                lines = tail.splitlines()[-100:]
+                with open(os.path.join(out, "metrics_tail.jsonl"), "w") as f:
+                    f.write("\n".join(lines) + "\n")
+                info["metrics_tail"] = "metrics_tail.jsonl"
+            except Exception as e:  # noqa: BLE001
+                info["metrics_tail_error"] = repr(e)
+
+        with open(os.path.join(out, "info.json"), "w") as f:
+            json.dump(info, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        self._emit_trip_telemetry(step)
+        return out
+
+    def _emit_trip_telemetry(self, step: int) -> None:
+        tel = self.telemetry
+        if tel is None or not getattr(tel, "enabled", False):
+            return
+        try:
+            tel.registry.counter("guardrails/watchdog_trips").inc(step=step)
+            tel.instant("guardrails_watchdog_trip", step=step)
+            tel.flush()
+        except Exception:  # noqa: BLE001 — never block the exit on telemetry
+            pass
+
+
+def is_watchdog_exit(rc: Optional[int]) -> bool:
+    """Did a child process die by watchdog? (The supervisor's immediate-
+    restart predicate; a custom exit_code must be passed to the supervisor
+    via ``immediate_restart_rcs``.)"""
+    return rc == GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT

@@ -58,6 +58,15 @@ def parse_args(args=None):
                              "newest complete resilience checkpoint")
     parser.add_argument("--max_restarts", type=int, default=3,
                         help="Restart budget for --auto_resume")
+    parser.add_argument("--max_backoff", type=float, default=60.0,
+                        help="Cap (seconds) on the exponential restart "
+                             "delay; watchdog exits (guardrails step "
+                             "deadline, distinct rc) restart immediately")
+    parser.add_argument("--watchdog_rc", type=int, default=None,
+                        help="Exit code treated as a guardrails-watchdog "
+                             "kill (immediate no-backoff restart). Set "
+                             "this when the ds-config overrides "
+                             "guardrails.watchdog.exit_code; default 113")
     parser.add_argument("user_script", type=str,
                         help="User training script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -247,7 +256,11 @@ def main(args=None):
             # restart on death; the resumed incarnation reads the newest
             # complete manifest via engine.auto_resume().
             from deepspeed_tpu.resilience import Supervisor
+            immediate = ({args.watchdog_rc} if args.watchdog_rc is not None
+                         else None)   # None -> supervisor default (113)
             sys.exit(Supervisor(cmd, max_restarts=args.max_restarts,
+                                max_backoff=args.max_backoff,
+                                immediate_restart_rcs=immediate,
                                 env=env).run())
         result = subprocess.run(cmd, env={**os.environ, **env})
         sys.exit(result.returncode)
@@ -293,9 +306,21 @@ def main(args=None):
     restarts = 0
     while rc != 0 and args.auto_resume and restarts < args.max_restarts:
         restarts += 1
-        logger.warning("job died rc=%s — auto-resume restart %d/%d",
-                       rc, restarts, args.max_restarts)
+        from deepspeed_tpu.config.constants import \
+            GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT
+        from deepspeed_tpu.guardrails.retry import backoff_delay
         from deepspeed_tpu.resilience import RESUME_ATTEMPT_ENV
+        watchdog_rc = (args.watchdog_rc if args.watchdog_rc is not None
+                       else GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT)
+        if rc == watchdog_rc:
+            delay = 0.0   # watchdog kill: the hang already burned its budget
+        else:
+            delay = backoff_delay(restarts - 1, base=1.0,
+                                  max_delay=args.max_backoff, jitter=0.25)
+        logger.warning("job died rc=%s — auto-resume restart %d/%d in %.1fs",
+                       rc, restarts, args.max_restarts, delay)
+        if delay:
+            time.sleep(delay)
         rc = launch_once({RESUME_ATTEMPT_ENV: str(restarts)})
     sys.exit(rc)
 

@@ -70,9 +70,35 @@ def init_distributed(dist_backend: str = "xla",
         os.environ.get("DSTPU_NUM_PROCS", os.environ.get("WORLD_SIZE", "1")))
     process_id = process_id if process_id is not None else int(
         os.environ.get("DSTPU_RANK", os.environ.get("RANK", "0")))
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    # Multi-host rendezvous through the shared jittered-backoff helper
+    # (guardrails/retry.py): on a pod restart the coordinator host may come
+    # up seconds after the workers, and one flaky DNS answer should not
+    # kill an otherwise healthy incarnation. DSTPU_INIT_RETRIES=0 restores
+    # fail-fast.
+    from deepspeed_tpu.guardrails.retry import retry_call
+
+    def rendezvous():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
+        except Exception:
+            # A failed connect leaves global_state.client/service assigned,
+            # and re-entering initialize() would then raise "should only be
+            # called once" — masking the real error and making every retry
+            # dead. shutdown() resets that state (no-op when nothing
+            # started), so the next attempt is a genuine re-rendezvous.
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort reset
+                pass
+            raise
+
+    retry_call(rendezvous,
+               max_retries=int(os.environ.get("DSTPU_INIT_RETRIES", "3")),
+               base=1.0, max_delay=15.0,
+               describe="jax.distributed.initialize")
     log_dist(f"jax.distributed initialised: {num_processes} processes "
              f"@ {coordinator_address}", ranks=[0])
 

@@ -293,10 +293,24 @@ class PipelineEngine(TPUEngine):
         leaves carry a leading microbatch dim == gradient_accumulation_steps
         (use ``split_batch`` to build them from a flat batch)."""
         tel = self.telemetry
-        with tel.span("pipe_step", step=self.global_steps,
-                      stages=self.num_stages,
-                      micro_batches=self.micro_batches) as sp:
-            loss = super().train_batch(batches)
+        # Outermost watchdog bracket carries the pipeline shape: a trip
+        # mid-pipe names the schedule (stages/microbatches) in the
+        # crashdump, which is the first thing a hung-collective post-mortem
+        # asks. The base engine's inner bracket is re-entrant (depth>1
+        # no-ops), so the deadline covers the whole pipe_step.
+        gr = self.guardrails
+        if gr is not None:
+            gr.step_begin(self.global_steps + 1,
+                          label=f"pipe_step[stages={self.num_stages},"
+                                f"mb={self.micro_batches}]")
+        try:
+            with tel.span("pipe_step", step=self.global_steps,
+                          stages=self.num_stages,
+                          micro_batches=self.micro_batches) as sp:
+                loss = super().train_batch(batches)
+        finally:
+            if gr is not None:
+                gr.step_end()
         if tel.enabled and self.num_stages > 1:
             # Per-stage bubble: in a GPipe/1F1B schedule every stage idles
             # (S-1) microbatch slots of the (M + S - 1)-slot step, so the

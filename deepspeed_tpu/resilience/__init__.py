@@ -4,6 +4,7 @@ fault injection, and elastic auto-resume (see docs/RESILIENCE.md)."""
 from deepspeed_tpu.resilience.checkpoint import (AsyncCheckpointManager,
                                                  ResilienceError,
                                                  find_restorable,
+                                                 install_state_arrays,
                                                  list_checkpoints, restore,
                                                  snapshot_engine)
 from deepspeed_tpu.resilience.fault import (FAULT_PLAN_ENV,
@@ -14,7 +15,7 @@ from deepspeed_tpu.resilience.supervisor import (ELASTIC_WORLD_ENV,
 
 __all__ = [
     "AsyncCheckpointManager", "ResilienceError", "find_restorable",
-    "list_checkpoints", "restore", "snapshot_engine",
+    "install_state_arrays", "list_checkpoints", "restore", "snapshot_engine",
     "FaultPlan", "corrupt_one_shard", "FAULT_PLAN_ENV", "RESUME_ATTEMPT_ENV",
     "Supervisor", "supervise_main", "ELASTIC_WORLD_ENV",
 ]

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.guardrails.retry import backoff_delay
 from deepspeed_tpu.resilience.fault import (RESUME_ATTEMPT_ENV, FaultPlan,
                                             corrupt_one_shard)
 from deepspeed_tpu.utils.logging import logger
@@ -306,7 +307,12 @@ class AsyncCheckpointManager:
                         return
                     self.stats["retries"] += 1
                     self._counter("ckpt/retries", step=snap.step)
-                    delay = self.backoff * (2 ** attempt)
+                    # Shared jittered-exponential schedule (guardrails/
+                    # retry.py): capped so a long outage never produces an
+                    # hour-long sleep, jittered so a pod's workers don't
+                    # hammer the recovered filesystem in lockstep.
+                    delay = backoff_delay(attempt, self.backoff,
+                                          max_delay=60.0, jitter=0.25)
                     logger.warning(
                         "checkpoint step %d write attempt %d failed (%s); "
                         "retrying in %.3fs", snap.step, attempt + 1, e, delay)
@@ -455,6 +461,44 @@ def find_restorable(ckpt_dir: str):
     return None
 
 
+def install_state_arrays(engine, arrays: Dict[str, np.ndarray], *,
+                         step: int, micro_steps: int,
+                         lr_scheduler_state: Optional[Dict] = None) -> None:
+    """Place named host arrays onto ``engine``'s current shardings and
+    install them as the live TrainState (plus step counters and scheduler
+    state). The shared epilogue of the on-disk :func:`restore` and the
+    guardrails in-memory rollback (guardrails/rollback.py) — one
+    implementation of "host arrays -> running engine"."""
+    import jax
+
+    template = engine._snapshot_state()
+    named, treedef = _flatten_named(template)
+    missing = [n for n, _ in named if n not in arrays]
+    if missing:
+        raise ResilienceError(
+            f"snapshot lacks state leaves {missing[:5]} — was it written "
+            "by a different model/optimizer configuration?")
+
+    def place(name, leaf):
+        arr = arrays[name]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ResilienceError(
+                f"leaf {name!r}: snapshot shape {arr.shape} != engine "
+                f"shape {np.shape(leaf)}")
+        arr = arr.astype(leaf.dtype)
+        if hasattr(leaf, "sharding"):
+            return jax.device_put(arr, leaf.sharding)
+        return arr
+
+    leaves = [place(name, leaf) for name, leaf in named]
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    engine._apply_restored_state(state)
+    engine.global_steps = int(step)
+    engine.micro_steps = int(micro_steps)
+    if engine.lr_scheduler is not None and lr_scheduler_state:
+        engine.lr_scheduler.load_state_dict(lr_scheduler_state)
+
+
 def restore(engine, ckpt_dir: str, monitor=None):
     """Auto-resume: load the newest complete checkpoint into ``engine``,
     resharding every leaf onto the engine's current placements (which may
@@ -462,8 +506,6 @@ def restore(engine, ckpt_dir: str, monitor=None):
 
     Returns ``(path, client_state)`` or ``(None, {})`` when there is
     nothing to resume from (fresh start)."""
-    import jax
-
     found = find_restorable(ckpt_dir)
     if found is None:
         logger.info("auto-resume: no usable checkpoint under %s — fresh "
@@ -478,32 +520,12 @@ def restore(engine, ckpt_dir: str, monitor=None):
             f"under {saved_hash[:12]} but this engine runs {engine_hash[:12]}"
             " — resuming would change the batch-size math mid-trajectory")
 
-    template = engine._snapshot_state()
-    named, treedef = _flatten_named(template)
-    missing = [n for n, _ in named if n not in arrays]
-    if missing:
-        raise ResilienceError(
-            f"checkpoint {path} lacks state leaves {missing[:5]} — was it "
-            "written by a different model/optimizer configuration?")
-
-    def place(name, leaf):
-        arr = arrays[name]
-        if tuple(arr.shape) != tuple(np.shape(leaf)):
-            raise ResilienceError(
-                f"leaf {name!r}: checkpoint shape {arr.shape} != engine "
-                f"shape {np.shape(leaf)}")
-        arr = arr.astype(leaf.dtype)
-        if hasattr(leaf, "sharding"):
-            return jax.device_put(arr, leaf.sharding)
-        return arr
-
-    leaves = [place(name, leaf) for name, leaf in named]
-    state = jax.tree_util.tree_unflatten(treedef, leaves)
-    engine._apply_restored_state(state)
-    engine.global_steps = int(manifest["step"])
-    engine.micro_steps = int(manifest["micro_steps"])
-    if engine.lr_scheduler is not None and manifest.get("lr_scheduler"):
-        engine.lr_scheduler.load_state_dict(manifest["lr_scheduler"])
+    try:
+        install_state_arrays(engine, arrays, step=int(manifest["step"]),
+                             micro_steps=int(manifest["micro_steps"]),
+                             lr_scheduler_state=manifest.get("lr_scheduler"))
+    except ResilienceError as e:
+        raise ResilienceError(f"checkpoint {path}: {e}") from e
 
     if int(manifest.get("dp_world_size", engine.dp_size)) != engine.dp_size:
         logger.info(

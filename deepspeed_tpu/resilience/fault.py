@@ -13,7 +13,22 @@ faults a run must survive:
   retry + exponential backoff;
 - ``corrupt_shard_at_step`` — after the checkpoint for step k commits, one
   shard file's bytes are flipped (torn write / bitrot), exercising manifest
-  digest verification and the fall-back to the previous complete manifest.
+  digest verification and the fall-back to the previous complete manifest;
+- ``nan_loss_at_step`` / ``nan_loss_steps`` — the batches for a window of
+  steps are NaN-poisoned before dispatch (a corrupted input shard / bad
+  preprocessing push), so the loss and gradients genuinely go non-finite
+  through the real step — exercising the guardrails detector + in-memory
+  rollback (guardrails/);
+- ``hang_at_step`` / ``hang_seconds`` — the step stalls mid-flight (the
+  deadlocked-collective shape), exercising the guardrails step watchdog's
+  diagnostics dump + distinct-rc exit and the supervisor's immediate
+  restart.
+
+The numeric/hang faults are keyed on **step attempts** (a monotonic count
+of dispatched steps) rather than ``global_steps``: a guardrails rollback
+rewinds the step counter, and keying on it would re-poison the retried
+window forever — a data-borne fault follows the data stream, which only
+moves forward.
 
 The plan comes from the config block (``resilience.fault_injection``) with an
 environment override (``DSTPU_FAULT_PLAN``, a JSON object merged over the
@@ -45,11 +60,19 @@ class FaultPlan:
     preempt_at_step: Optional[int] = None
     ckpt_write_errors: int = 0
     corrupt_shard_at_step: Optional[int] = None
+    nan_loss_at_step: Optional[int] = None
+    nan_loss_steps: int = 1
+    hang_at_step: Optional[int] = None
+    hang_seconds: float = 3600.0
     max_attempt: int = 0
 
     def __post_init__(self):
         if self.ckpt_write_errors < 0:
             raise ValueError("ckpt_write_errors must be >= 0")
+        if self.nan_loss_steps < 1:
+            raise ValueError("nan_loss_steps must be >= 1")
+        if self.hang_seconds <= 0:
+            raise ValueError("hang_seconds must be > 0")
         self._io_errors_left = int(self.ckpt_write_errors)
 
     # ------------------------------------------------------------------
@@ -98,6 +121,43 @@ class FaultPlan:
     def should_corrupt(self, global_step: int) -> bool:
         return (self.corrupt_shard_at_step is not None
                 and global_step == self.corrupt_shard_at_step)
+
+    def should_nan_loss(self, step_attempt: int) -> bool:
+        """Poison the batch for this step attempt? Active for the window
+        ``[nan_loss_at_step, nan_loss_at_step + nan_loss_steps)``."""
+        return (self.nan_loss_at_step is not None
+                and self.nan_loss_at_step <= step_attempt
+                < self.nan_loss_at_step + self.nan_loss_steps)
+
+    def poison_batch(self, batch):
+        """NaN-fill every floating leaf of a host batch pytree (the
+        corrupted-input-shard shape: the step runs for real and its loss /
+        grads genuinely go non-finite)."""
+        import numpy as np
+
+        def leaf(x):
+            x = np.asarray(x)
+            if np.issubdtype(x.dtype, np.floating):
+                return np.full_like(x, np.nan)
+            return x
+
+        import jax
+        logger.warning("FaultPlan: NaN-poisoning the batch for this step")
+        return jax.tree_util.tree_map(leaf, batch)
+
+    def should_hang(self, step_attempt: int) -> bool:
+        return (self.hang_at_step is not None
+                and step_attempt == self.hang_at_step)
+
+    def hang(self) -> None:
+        """Stall in-step (the deadlocked-collective / stuck-host-callback
+        shape). The guardrails watchdog is expected to kill the process
+        long before ``hang_seconds`` elapses."""
+        import time
+
+        logger.warning("FaultPlan: injecting in-step hang (%.0fs) — the "
+                       "watchdog should trip first", self.hang_seconds)
+        time.sleep(self.hang_seconds)
 
     def preempt(self, global_step: int) -> None:
         """Deliver the injected preemption: SIGTERM to self, default

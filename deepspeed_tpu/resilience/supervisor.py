@@ -20,22 +20,40 @@ import os
 import subprocess
 import sys
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional
 
+from deepspeed_tpu.config.constants import \
+    GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT
+from deepspeed_tpu.guardrails.retry import backoff_delay
 from deepspeed_tpu.resilience.fault import RESUME_ATTEMPT_ENV
 from deepspeed_tpu.utils.logging import logger
 
 ELASTIC_WORLD_ENV = "DSTPU_ELASTIC_WORLD"
+# Cap on the exponential restart delay: a crash-looping job's delay grew
+# without bound before (backoff * 2**(restarts-1)); past ~a minute more
+# waiting buys nothing — either the fault is transient (the cap is plenty)
+# or it is permanent (the restart budget ends the loop).
+MAX_RESTART_BACKOFF_DEFAULT = 60.0
 
 
 class Supervisor:
-    """Restart-on-death driver for one training command."""
+    """Restart-on-death driver for one training command.
+
+    Restart delays follow the shared capped + jittered exponential schedule
+    (guardrails/retry.py). Exit codes listed in ``immediate_restart_rcs``
+    (by default the guardrails watchdog's distinct rc) restart with NO
+    delay: a watchdog kill means the job already sat through a full step
+    deadline doing nothing — backing off on top would double the waste.
+    """
 
     def __init__(self,
                  cmd: List[str],
                  max_restarts: int = 3,
                  env: Optional[Dict[str, str]] = None,
                  backoff: float = 0.5,
+                 max_backoff: float = MAX_RESTART_BACKOFF_DEFAULT,
+                 jitter: float = 0.25,
+                 immediate_restart_rcs: Optional[Iterable[int]] = None,
                  ckpt_dir: Optional[str] = None,
                  available_worlds: Optional[Callable[[int], int]] = None):
         if max_restarts < 0:
@@ -44,9 +62,15 @@ class Supervisor:
         self.max_restarts = int(max_restarts)
         self.env = dict(env or {})
         self.backoff = float(backoff)
+        self.max_backoff = float(max_backoff)
+        self.jitter = float(jitter)
+        self.immediate_restart_rcs = set(
+            immediate_restart_rcs if immediate_restart_rcs is not None
+            else (GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT,))
         self.ckpt_dir = ckpt_dir
         self.available_worlds = available_worlds
         self.restarts = 0
+        self.immediate_restarts = 0
         self.exit_codes: List[int] = []
         self.metrics = None
         if ckpt_dir:
@@ -94,14 +118,24 @@ class Supervisor:
                 return rc
             self.restarts += 1
             attempt += 1
-            delay = self.backoff * (2 ** (self.restarts - 1))
+            if rc in self.immediate_restart_rcs:
+                # Watchdog-style death: the hang already consumed a full
+                # step deadline — restart NOW.
+                self.immediate_restarts += 1
+                delay = 0.0
+            else:
+                delay = backoff_delay(self.restarts - 1, self.backoff,
+                                      max_delay=self.max_backoff,
+                                      jitter=self.jitter)
             logger.warning(
-                "supervisor: worker died rc=%d — restart %d/%d in %.2fs",
-                rc, self.restarts, self.max_restarts, delay)
+                "supervisor: worker died rc=%d — restart %d/%d in %.2fs%s",
+                rc, self.restarts, self.max_restarts, delay,
+                " (immediate: watchdog rc)" if delay == 0.0 else "")
             if self.metrics is not None:
                 self.metrics.add_scalar("Train/Resilience/worker_exit_code",
                                         rc, attempt)
-            time.sleep(delay)
+            if delay > 0.0:
+                time.sleep(delay)
 
 
 def supervise_main(argv: Optional[List[str]] = None) -> int:
@@ -114,6 +148,15 @@ def supervise_main(argv: Optional[List[str]] = None) -> int:
                     "failure")
     ap.add_argument("--max_restarts", type=int, default=3)
     ap.add_argument("--backoff", type=float, default=0.5)
+    ap.add_argument("--max_backoff", type=float,
+                    default=MAX_RESTART_BACKOFF_DEFAULT,
+                    help="Cap on the exponential restart delay (seconds)")
+    ap.add_argument("--immediate_rc", type=int, action="append",
+                    default=None,
+                    help="Exit code restarted with NO backoff (repeatable);"
+                         " default: the guardrails watchdog rc 113. Set "
+                         "when the ds-config overrides "
+                         "guardrails.watchdog.exit_code")
     ap.add_argument("--checkpoint_dir", type=str, default=None)
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="training command (prefix with --)")
@@ -122,7 +165,9 @@ def supervise_main(argv: Optional[List[str]] = None) -> int:
     if not cmd:
         ap.error("no command given")
     return Supervisor(cmd, max_restarts=args.max_restarts,
-                      backoff=args.backoff, ckpt_dir=args.checkpoint_dir).run()
+                      backoff=args.backoff, max_backoff=args.max_backoff,
+                      immediate_restart_rcs=args.immediate_rc,
+                      ckpt_dir=args.checkpoint_dir).run()
 
 
 if __name__ == "__main__":

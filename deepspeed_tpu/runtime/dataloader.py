@@ -143,6 +143,19 @@ class RepeatingLoader:
         self.batch_in_epoch += 1
         return out
 
+    def skip_batches(self, n: int) -> int:
+        """Advance the stream past ``n`` batches without yielding them —
+        the guardrails rollback hook (register as
+        ``engine.register_data_skip_fn(loader.skip_batches)``; the policy
+        calls it to move past a poisoned window). Goes *through*
+        ``__next__`` so epoch rollovers behave identically to consumption,
+        keeping ``state_dict`` replay exact across a skip. Returns n."""
+        if n < 0:
+            raise ValueError("skip_batches: n must be >= 0")
+        for _ in range(int(n)):
+            next(self)
+        return int(n)
+
     def state_dict(self) -> dict:
         return {"epoch": self.epoch, "batch_in_epoch": self.batch_in_epoch}
 

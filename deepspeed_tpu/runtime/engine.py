@@ -155,6 +155,10 @@ class TPUEngine:
 
         # --- precision ------------------------------------------------------
         self.precision = PrecisionPolicy(config.precision_dtype)
+        # In-device skip-on-nonfinite-grads for bf16/fp32 runs (satellite
+        # of the fp16 overflow path; config-gated, default off — the
+        # predicate rides inside the jitted step functions built below).
+        self._nonfinite_grad_check = config.guardrails.nonfinite_grad_check
         # GAS accumulator dtype (config data_types.grad_accum_dtype): fp32
         # default; bf16 halves the accumulator's HBM read+write per
         # microbatch — the reference's fp16 engine accumulates in half
@@ -329,6 +333,22 @@ class TPUEngine:
                 fault_plan=self.fault_plan,
                 monitor=self.monitor,
                 telemetry=self.telemetry)
+        # --- guardrails: anomaly detection + in-memory rollback + watchdog --
+        # (guardrails/; docs/RESILIENCE.md "Guardrails"). build_guardrails
+        # returns None for a disabled block, and every engine hook gates on
+        # `is None` — the disabled step path is bit-for-bit the pre-
+        # guardrails one: no host fetches, no syncs, no snapshots.
+        from deepspeed_tpu.guardrails import build_guardrails
+        tcfg = config.telemetry
+        self.guardrails = build_guardrails(
+            config.guardrails, telemetry=self.telemetry,
+            metrics_path=(os.path.join(tcfg.dir, tcfg.metrics.file)
+                          if tcfg.enabled else None))
+        # Monotonic count of dispatched optimizer-step attempts. Unlike
+        # global_steps it never rewinds on rollback: data-borne fault
+        # injection (FaultPlan nan_loss/hang) keys on it so a rolled-back
+        # window is not re-poisoned forever.
+        self.step_attempts = 0
         # Device-sync barriers in the timers are gated on wall_clock_breakdown:
         # a breakdown-off run must not pay a block_until_ready round-trip per
         # step just to feed timings nobody reads.
@@ -545,7 +565,11 @@ class TPUEngine:
             zeros = jax.lax.with_sharding_constraint(zeros, grad_shardings)
             (acc, rng), losses = jax.lax.scan(body, (zeros, rng), batches)
             acc = jax.lax.with_sharding_constraint(acc, grad_shardings)
-            overflow = (has_inf_or_nan(acc) if fp16
+            # fp16 always checks (loss-scaler contract); bf16/fp32 check
+            # only under the guardrails nonfinite-grad opt-in — no perf
+            # tax on the default path.
+            overflow = (has_inf_or_nan(acc)
+                        if fp16 or self._nonfinite_grad_check
                         else jnp.zeros((), jnp.bool_))
             # norm in fp32 (a bf16 square-sum overflows at scale; the cast
             # fuses into the reduction)
@@ -609,7 +633,8 @@ class TPUEngine:
             self._compute_params, state.rng, batches, jnp.float32(scale_f))
         grads_h = to_host(acc)
         norm_h = to_host(norm_d)
-        overflow_h = (to_host(overflow_d) if fp16
+        overflow_h = (to_host(overflow_d)
+                      if fp16 or self._nonfinite_grad_check
                       else jnp.zeros((), jnp.bool_))
         # Unscale (+ compensate prescale_gradients' in-loss pre-division,
         # as _make_apply_step does); clipping happens inside the jitted
@@ -618,6 +643,9 @@ class TPUEngine:
         if cfg.prescale_gradients:
             coef = coef * self.dp_size / cfg.gradient_predivide_factor
         self._offload_last_norm = (norm_h, coef)
+        # Guardrails feed: the lazy overflow scalar (fetched only when the
+        # detector is enabled — _guardrails_step_hook gates the sync).
+        self._offload_last_overflow = overflow_h
         lr = float(self._current_lr())
         compute_h = self.offloader.update(grads_h, lr, coef, overflow_h,
                                           norm=norm_h,
@@ -672,6 +700,8 @@ class TPUEngine:
         optimizer = self.optimizer
         scaler = self.loss_scaler
 
+        nonfinite_check = self._nonfinite_grad_check
+
         def apply_step(state: TrainState, lr):
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
             inv = 1.0 / scale
@@ -679,7 +709,12 @@ class TPUEngine:
                 inv = inv * self.dp_size / cfg.gradient_predivide_factor
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32) * inv, state.grad_acc)
-            overflow = has_inf_or_nan(grads) if fp16 else jnp.zeros((), jnp.bool_)
+            # fp16: the loss-scaler overflow path. bf16/fp32: the same
+            # skip-on-nonfinite semantics under the (default-off)
+            # guardrails gate — engine.py previously hard-coded
+            # overflow = zeros() for bf16, leaving NaN grads to commit.
+            overflow = (has_inf_or_nan(grads) if fp16 or nonfinite_check
+                        else jnp.zeros((), jnp.bool_))
             norm = global_norm(grads)
             if clip > 0.0:
                 grads = clip_grad_by_global_norm(grads, clip, norm=norm)
@@ -926,7 +961,7 @@ class TPUEngine:
                 norm = jnp.sqrt(jax.lax.psum(local_sq, red_axes) / nr)
                 coef = jnp.minimum(1.0, clip / (norm + 1e-6))
                 grads = jax.tree_util.tree_map(lambda g: g * coef, grads)
-            if fp16:
+            if fp16 or self._nonfinite_grad_check:
                 local_of = has_inf_or_nan(grads).astype(jnp.int32)
                 overflow = jax.lax.pmax(local_of, all_manual) > 0
             else:
@@ -1038,8 +1073,16 @@ class TPUEngine:
 
     def _current_lr(self) -> jax.Array:
         if self.lr_scheduler is not None:
-            return jnp.float32(self.lr_scheduler.lr_at(self.global_steps))
-        return jnp.float32(self._base_lr)
+            lr = jnp.float32(self.lr_scheduler.lr_at(self.global_steps))
+        else:
+            lr = jnp.float32(self._base_lr)
+        # Rollback-driven LR decay (guardrails.rollback.lr_decay): a
+        # multiplicative scale over whatever the schedule says, so decaying
+        # after an instability composes with any scheduler.
+        gr = self.guardrails
+        if gr is not None and gr.lr_scale != 1.0:
+            lr = lr * jnp.float32(gr.lr_scale)
+        return lr
 
     def put_batch(self, batch, leading_gas_dim: bool = False):
         """Shard a host batch across the data axis. With ``leading_gas_dim``
@@ -1174,22 +1217,35 @@ class TPUEngine:
             self.train_batch(batches)
             self.micro_steps = micro_before
             return
-        if self.wall_clock_breakdown:
-            self.timers("step").start()
-        lr = self._current_lr()
-        with self.telemetry.span("optimizer_step", step=self.global_steps):
-            self.state, overflow, _ = self._apply_step(self.state, lr)
-        self._micro_in_window = 0
-        self.global_steps += 1
-        if self.lr_scheduler is not None:
-            self.lr_scheduler.step()
-        if self.wall_clock_breakdown:
-            self.timers("step").stop()
+        self.step_attempts += 1
+        gr = self.guardrails
+        if gr is not None:
+            gr.step_begin(self.global_steps + 1, label="optimizer_step")
+        try:
+            fp = self.fault_plan
+            if fp is not None and fp.should_hang(self.step_attempts):
+                fp.hang()
+            if self.wall_clock_breakdown:
+                self.timers("step").start()
+            lr = self._current_lr()
+            with self.telemetry.span("optimizer_step",
+                                     step=self.global_steps):
+                self.state, overflow, norm = self._apply_step(self.state, lr)
+            self._micro_in_window = 0
+            self.global_steps += 1
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            if self.wall_clock_breakdown:
+                self.timers("step").stop()
+        finally:
+            if gr is not None:
+                gr.step_end()
         if self.global_steps % self.steps_per_print == 0:
             loss = float(self._last_loss) if self._last_loss is not None else float("nan")
             log_dist(f"step={self.global_steps} loss={loss:.4f} "
                      f"lr={float(lr):.3e} loss_scale={float(self.state.loss_scale.scale):.1f}",
                      ranks=[0])
+        self._guardrails_step_hook(self._last_loss, overflow, norm)
         if self._last_loss is not None:
             self._post_step_hooks(self._last_loss)
         self._emit_step_telemetry()
@@ -1310,6 +1366,20 @@ class TPUEngine:
         """Fused full step: ``batches`` is a pytree whose leaves have leading
         dim gradient_accumulation_steps (one entry per micro-batch)."""
         self._pending_micro = []   # direct call supersedes any stashed loop
+        self.step_attempts += 1
+        fp = self.fault_plan
+        if fp is not None and fp.should_nan_loss(self.step_attempts):
+            batches = fp.poison_batch(batches)
+        gr = self.guardrails
+        if gr is not None:
+            gr.step_begin(self.global_steps + 1)
+        try:
+            return self._train_batch_inner(batches)
+        finally:
+            if gr is not None:
+                gr.step_end()
+
+    def _train_batch_inner(self, batches) -> jax.Array:
         tel = self.telemetry
         self.tput_timer.start()
         if self.wall_clock_breakdown:
@@ -1322,6 +1392,11 @@ class TPUEngine:
             self.timers("dataloader").stop()
         tel.check_recompile("engine.train_step", batches,
                             step=self.global_steps)
+        fp = self.fault_plan
+        if fp is not None and fp.should_hang(self.step_attempts):
+            # In the armed watchdog window, before the step program: the
+            # deadlocked-collective shape a real hang takes.
+            fp.hang()
         if self._train_step is None:  # offloaded optimizer tier
             with tel.span("train_step", step=self.global_steps):
                 loss = self._offload_train_batch(batches)
@@ -1331,7 +1406,18 @@ class TPUEngine:
                 self.lr_scheduler.step()
             self.tput_timer.stop()
             self._last_loss = loss
-            if self.config.check_numerics:
+            # Feed the UNSCALED grad norm (norm_h is pre-unscale; coef is
+            # the same factor get_global_grad_norm applies) so the offload
+            # tier gets the same grad-norm anomaly coverage as the device
+            # tiers. The tiny host-side multiply is built only when a
+            # detector is listening.
+            norm = None
+            if self.guardrails is not None:
+                norm_h, coef = self._offload_last_norm
+                norm = norm_h * coef
+            rolled_back = self._guardrails_step_hook(
+                loss, getattr(self, "_offload_last_overflow", None), norm)
+            if self.config.check_numerics and not rolled_back:
                 self._check_numerics(loss, overflow=False)
             self._post_step_hooks(loss)
             self._emit_step_telemetry()
@@ -1341,15 +1427,16 @@ class TPUEngine:
         self._maybe_profile(self._train_step, self.state, batches, lr,
                             params=self.state.params)
         with tel.span("train_step", step=self.global_steps):
-            self.state, loss, overflow, _ = self._train_step(self.state,
-                                                             batches, lr)
+            self.state, loss, overflow, norm = self._train_step(self.state,
+                                                                batches, lr)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self.tput_timer.stop()
         self._last_loss = loss
-        if self.config.check_numerics:
+        rolled_back = self._guardrails_step_hook(loss, overflow, norm)
+        if self.config.check_numerics and not rolled_back:
             self._check_numerics(loss, overflow=bool(overflow))
         self._post_step_hooks(loss)
         self._emit_step_telemetry()
@@ -1443,9 +1530,20 @@ class TPUEngine:
         at the configured interval (the write happens on the manager's
         background thread — off the step path) and deliver any injected
         preemption. Save first, then preempt: the interrupted write is
-        exactly the torn-checkpoint case the manifest protocol handles."""
+        exactly the torn-checkpoint case the manifest protocol handles.
+
+        With guardrails on, a step the detector just called a SPIKE is
+        numerically suspect (in bf16 its NaN grads COMMITTED) — writing it
+        would make the newest on-disk checkpoint the poisoned one, which is
+        exactly what rollback escalation and post-watchdog auto-resume
+        restore. Skip the interval save for spike steps; the next ok step
+        saves as usual."""
+        gr = self.guardrails
+        suspect = (gr is not None and gr.last_verdict is not None
+                   and bool(gr.last_verdict))
         mgr = self.ckpt_manager
-        if mgr is not None and self.global_steps % mgr.interval == 0:
+        if (mgr is not None and not suspect
+                and self.global_steps % mgr.interval == 0):
             self.save_checkpoint_async()
         if (self.fault_plan is not None
                 and self.fault_plan.should_preempt(self.global_steps)):
@@ -1455,6 +1553,28 @@ class TPUEngine:
         """Callable whose result rides every auto-checkpoint as
         client_state (e.g. ``loader.state_dict`` for dataloader replay)."""
         self._client_state_fn = fn
+
+    # ------------------------------------------------------------------
+    # Guardrails — anomaly detection, in-memory rollback, step watchdog
+    # (guardrails/; docs/RESILIENCE.md "Guardrails")
+    # ------------------------------------------------------------------
+    def register_data_skip_fn(self, fn: Callable[[int], int]) -> None:
+        """Callable(n) advancing the data stream past n batches — the
+        rollback policy uses it to move past a poisoned window (pass
+        ``RepeatingLoader.skip_batches``). No-op without a guardrails
+        block (nothing else consumes it)."""
+        if self.guardrails is not None:
+            self.guardrails.register_data_skip_fn(fn)
+
+    def _guardrails_step_hook(self, loss, overflow, norm) -> bool:
+        """Post-step detector feed. Returns True when a rollback rewound
+        the engine this step (the caller then skips its own fail-fast
+        numerics raise — the anomaly was HANDLED). Disabled guardrails is
+        one attribute check: no host fetch, no device sync."""
+        gr = self.guardrails
+        if gr is None or loss is None:
+            return False
+        return gr.after_step(self, loss, overflow, norm)
 
     def save_checkpoint_async(self,
                               client_state: Optional[Dict] = None) -> None:

@@ -54,6 +54,11 @@ class Telemetry:
                         step: Optional[int] = None) -> str:
         return self.recompile.check(fn_name, *trees, step=step)
 
+    def instant(self, name: str, **args) -> None:
+        """Trace instant event (guardrails spike/rollback/watchdog markers
+        land next to the step spans in the same Perfetto timeline)."""
+        self.tracer.instant(name, **args)
+
     def set_step(self, step: int) -> None:
         self.registry.set_step(step)
 
