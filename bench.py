@@ -337,6 +337,12 @@ def main():
         "devices": len(jax.devices()),
         "jax": jax.__version__,
         "telemetry": "off",
+        # Gradient-sync strategy the rows were measured under
+        # (comm/grad_sync.py): none of the bench configs set a comm
+        # block, so the implicit full-precision path is timed. A future
+        # PR benching with hierarchical quantized sync on must record
+        # its comm block here so BENCH_*.json rows stay attributable.
+        "comm": {"hierarchical": "off"},
     }
 
     if on_tpu:

@@ -1,9 +1,19 @@
-"""Communication backends: named collectives + compressed (1-bit) allreduce."""
+"""Communication backends: named collectives, compressed (1-bit)
+allreduce, blockwise quantization, and the hierarchical grad-sync
+strategy (docs/PERFORMANCE.md)."""
 
 from deepspeed_tpu.comm import collectives
 from deepspeed_tpu.comm.compressed import (compressed_allreduce,
                                            compressed_allreduce_local,
                                            pack_signs, unpack_signs)
+from deepspeed_tpu.comm.grad_sync import (GradSyncPlan, GradSyncStrategy,
+                                          comm_dtype_from_config,
+                                          resolve_hierarchical)
+from deepspeed_tpu.comm.quantize import (dequantize_blockwise,
+                                         quantize_blockwise)
 
 __all__ = ["collectives", "compressed_allreduce",
-           "compressed_allreduce_local", "pack_signs", "unpack_signs"]
+           "compressed_allreduce_local", "pack_signs", "unpack_signs",
+           "GradSyncPlan", "GradSyncStrategy", "comm_dtype_from_config",
+           "resolve_hierarchical", "quantize_blockwise",
+           "dequantize_blockwise"]

@@ -43,19 +43,24 @@ def pack_signs(bits: jax.Array) -> jax.Array:
     return jnp.sum(grouped * _POW2, axis=-1, dtype=jnp.uint8)
 
 
-def unpack_signs(packed: jax.Array, n: int) -> jax.Array:
-    """uint8[..., k] -> float[..., 8k] of ±1."""
+def unpack_signs(packed: jax.Array, n: int,
+                 dtype=jnp.float32) -> jax.Array:
+    """uint8[..., k] -> ``dtype``[..., 8k] of ±1. The decompress dtype is
+    a parameter so a bf16 error-feedback pipeline stays bf16 end-to-end
+    instead of silently upcasting every unpacked sign to fp32."""
     bits = (packed[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
     bits = bits.reshape(*packed.shape[:-1], -1)[..., :n]
-    return bits.astype(jnp.float32) * 2.0 - 1.0
+    return bits.astype(dtype) * 2.0 - 1.0
 
 
 def _compress(x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """-> (packed uint8, scale, decompressed). Scale = mean|x| preserves the
-    l1 norm under sign compression (the reference's scale choice)."""
+    """-> (packed uint8, scale, decompressed). Scale = mean|x| preserves
+    the l1 norm under sign compression (the reference's scale choice).
+    Scale and decompressed stay in x's dtype — the 1-bit protocol's
+    error-feedback arithmetic must not upcast bf16 traffic to fp32."""
     scale = jnp.mean(jnp.abs(x), axis=-1, keepdims=True)
     bits = x >= 0
-    decompressed = (bits.astype(jnp.float32) * 2.0 - 1.0) * scale
+    decompressed = (bits.astype(x.dtype) * 2.0 - 1.0) * scale
     return pack_signs(bits), scale, decompressed
 
 
@@ -81,7 +86,10 @@ def compressed_allreduce_local(x: jax.Array,
     recv_scales = jax.lax.all_to_all(scales, axis, split_axis=0,
                                      concat_axis=0, tiled=False)
     # -- server phase: average my chunk across workers, re-compress --------
-    signs = unpack_signs(recv_packed, chunk)              # [n, chunk] ±1
+    # Decompress in x's dtype throughout: the error-feedback state carries
+    # the caller's precision and a hard-coded fp32 here used to upcast
+    # every bf16 pipeline (jaxpr-level test in tests/test_onebit.py).
+    signs = unpack_signs(recv_packed, chunk, dtype=x.dtype)  # [n, chunk] ±1
     avg = jnp.mean(signs * recv_scales, axis=0)           # [chunk]
     served = avg + server_error
     s_packed, s_scale, s_decompressed = _compress(served[None])
@@ -89,7 +97,7 @@ def compressed_allreduce_local(x: jax.Array,
     # -- gather the served chunks back to everyone -------------------------
     all_packed = jax.lax.all_gather(s_packed, axis, axis=0)   # [n,1,chunk/8]
     all_scales = jax.lax.all_gather(s_scale, axis, axis=0)    # [n,1,1]
-    result = (unpack_signs(all_packed[:, 0], chunk) *
+    result = (unpack_signs(all_packed[:, 0], chunk, dtype=x.dtype) *
               all_scales[:, 0]).reshape(numel)
     return result, new_worker_error, new_server_error
 
@@ -104,7 +112,7 @@ def sync_momentum_compressed(m_local: jax.Array,
     error-compensated allreduce, and reshape back. Must run inside a
     data-manual shard_map region."""
     numel = int(m_local.size)
-    flat = jnp.zeros(worker_error.shape[0], jnp.float32)
+    flat = jnp.zeros(worker_error.shape[0], m_local.dtype)
     flat = flat.at[:numel].set(m_local.reshape(-1))
     synced, we_new, se_new = compressed_allreduce_local(
         flat, worker_error, server_error, axis, n)

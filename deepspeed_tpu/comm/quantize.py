@@ -1,0 +1,85 @@
+"""Blockwise symmetric quantization for cross-slice gradient traffic.
+
+The DCN links between TPU slices are an order of magnitude slower than
+ICI, so the bytes a gradient all-reduce puts on them dominate multi-slice
+step time. ZeRO++ (arXiv 2306.10209) shows blockwise-quantized gradient
+collectives cut that traffic ~4x with negligible quality loss, and EQuARX
+(arXiv 2506.17615) demonstrates the same transformation inside XLA. This
+module is the numeric half of that design: deterministic int8 round-trips
+with per-block fp32 scales, used by :mod:`deepspeed_tpu.comm.grad_sync`
+to compress the DCN stage of the hierarchical gradient sync.
+
+Properties the grad-sync protocol relies on (tested in tests/test_dcn.py):
+
+- **deterministic**: round-to-nearest-even, no stochastic rounding — the
+  same input always produces the same wire bytes, so replayed steps (the
+  resilience/guardrails machinery) stay reproducible.
+- **zero-preserving**: an all-zero block quantizes to zeros and
+  dequantizes to exact zeros (scale guard, no 0/0).
+- **infinity-free**: finite inputs produce finite outputs (values clip to
+  the int8 range; scales are finite for finite blocks).
+- **overflow-transparent**: a block containing inf/NaN gets a NaN scale,
+  so the dequantized block is NaN — ``has_inf_or_nan`` on the synced
+  grads still sees the overflow the fp16 loss-scaler must skip on.
+- **max-preserving**: the per-block absmax survives the round-trip to
+  within one float32 rounding of ``amax`` (the max element maps to ±qmax
+  exactly, and dequantizing gives ``qmax * (amax / qmax)``).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def qmax_for_bits(bits: int) -> int:
+    """Largest magnitude representable by a signed ``bits``-wide code."""
+    return 2 ** (bits - 1) - 1
+
+
+def quantize_blockwise(x: jax.Array, block_size: int,
+                       bits: int = 8) -> Tuple[jax.Array, jax.Array]:
+    """Quantize the last dim of ``x`` in blocks of ``block_size``.
+
+    x: [..., m] float array, m % block_size == 0.
+    Returns (q int8 [..., m], scales fp32 [..., m // block_size]).
+
+    The math runs in fp32 regardless of the input dtype (a bf16 absmax /
+    divide would add avoidable quantization noise); the caller controls
+    the wire dtypes: int8 codes + fp32 scales.
+    """
+    if bits != 8:
+        raise ValueError(f"quantize_blockwise supports bits=8, got {bits}")
+    *lead, m = x.shape
+    if m % block_size:
+        raise ValueError(f"last dim {m} not divisible by block {block_size}")
+    qmax = float(qmax_for_bits(bits))
+    blocks = x.reshape(*lead, m // block_size, block_size).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    finite = jnp.isfinite(amax)
+    # Zero blocks: scale 1 so q = round(0/1) = 0 and dequant is exact 0.
+    safe = jnp.where(finite & (amax > 0), amax, jnp.float32(1.0))
+    scale = safe / qmax
+    q = jnp.clip(jnp.round(blocks / scale), -qmax, qmax).astype(jnp.int8)
+    # Non-finite blocks poison their scale: dequantize yields NaN, keeping
+    # the overflow visible to the loss-scaler's skip logic downstream.
+    scale = jnp.where(finite, scale, jnp.float32(jnp.nan))
+    return q.reshape(*lead, m), scale[..., 0]
+
+
+def dequantize_blockwise(q: jax.Array, scales: jax.Array,
+                         block_size: int) -> jax.Array:
+    """Inverse of :func:`quantize_blockwise` — fp32 output [..., m]."""
+    *lead, m = q.shape
+    blocks = q.reshape(*lead, m // block_size, block_size).astype(jnp.float32)
+    out = blocks * scales[..., None]
+    return out.reshape(*lead, m)
+
+
+def modeled_wire_bytes(num_elems: int, bits: int, block_size: int) -> int:
+    """Bytes one direction of a quantized transfer of ``num_elems`` puts
+    on the wire: payload codes + per-block fp32 scales. For the bf16/fp32
+    passthrough tiers (bits 16/32) there are no scales."""
+    if bits == 8:
+        return num_elems + 4 * (num_elems // block_size)
+    return num_elems * (bits // 8)

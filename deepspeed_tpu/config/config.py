@@ -398,6 +398,59 @@ class MeshConfig:
 
 
 @dataclass
+class CommConfig:
+    """``comm`` block — the hierarchical quantized gradient-sync strategy
+    (comm/grad_sync.py, docs/PERFORMANCE.md).
+
+    ``hierarchical``: ``auto`` engages the explicit bucketed sync on
+    multi-slice (dcn > 1) meshes when the step path supports it; ``on``
+    forces it (raising on incompatible configurations); ``off`` keeps
+    today's implicit pjit resharding, bit-identical.
+    ``dcn_quant_bits``: the DCN wire dtype — 8 (blockwise int8 + per-block
+    fp32 scales), 16 (bf16 passthrough) or 32 (fp32 passthrough).
+    ``quant_block_size``: elements per quantization block (per-block
+    absmax scale granularity).
+    ``bucket_mb``: flat gradient bucket size in MiB (the unit of the ICI
+    reduce-scatter and DCN all-reduce).
+    """
+
+    hierarchical: str = C.COMM_HIERARCHICAL_DEFAULT
+    dcn_quant_bits: int = C.COMM_DCN_QUANT_BITS_DEFAULT
+    quant_block_size: int = C.COMM_QUANT_BLOCK_SIZE_DEFAULT
+    bucket_mb: float = C.COMM_BUCKET_MB_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CommConfig":
+        d = d or {}
+        cfg = cls(
+            hierarchical=str(_get(d, C.COMM_HIERARCHICAL,
+                                  C.COMM_HIERARCHICAL_DEFAULT)).lower(),
+            dcn_quant_bits=int(_get(d, C.COMM_DCN_QUANT_BITS,
+                                    C.COMM_DCN_QUANT_BITS_DEFAULT)),
+            quant_block_size=int(_get(d, C.COMM_QUANT_BLOCK_SIZE,
+                                      C.COMM_QUANT_BLOCK_SIZE_DEFAULT)),
+            bucket_mb=float(_get(d, C.COMM_BUCKET_MB,
+                                 C.COMM_BUCKET_MB_DEFAULT)),
+        )
+        if cfg.hierarchical not in ("auto", "on", "off"):
+            raise ConfigError(
+                f"comm.hierarchical must be auto|on|off, got "
+                f"'{cfg.hierarchical}'")
+        if cfg.dcn_quant_bits not in (8, 16, 32):
+            raise ConfigError(
+                f"comm.dcn_quant_bits must be 8 (int8), 16 (bf16) or 32 "
+                f"(fp32), got {cfg.dcn_quant_bits}")
+        if cfg.quant_block_size <= 0:
+            raise ConfigError(
+                f"comm.quant_block_size must be positive, got "
+                f"{cfg.quant_block_size}")
+        if cfg.bucket_mb <= 0:
+            raise ConfigError(
+                f"comm.bucket_mb must be positive, got {cfg.bucket_mb}")
+        return cfg
+
+
+@dataclass
 class AIOConfig:
     block_size: int = C.AIO_BLOCK_SIZE_DEFAULT
     queue_depth: int = C.AIO_QUEUE_DEPTH_DEFAULT
@@ -582,7 +635,21 @@ class DeepSpeedTPUConfig:
                                             C.PRESCALE_GRADIENTS_DEFAULT))
         self.gradient_predivide_factor = float(_get(d, C.GRADIENT_PREDIVIDE_FACTOR,
                                                     C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT))
+        # communication_data_type: the ICI reduction dtype for the
+        # grad-sync strategy (comm/grad_sync.py) and the 1-bit path's
+        # dense intra-slice pre-reduction. None ≡ the accumulator's
+        # native dtype.
         self.communication_data_type = d.get(C.COMMUNICATION_DATA_TYPE)
+        if self.communication_data_type is not None:
+            self.communication_data_type = \
+                str(self.communication_data_type).lower()
+            if self.communication_data_type not in (
+                    "fp32", "float32", "bf16", "bfloat16", "fp16",
+                    "float16"):
+                raise ConfigError(
+                    f"communication_data_type must be one of fp32/float32/"
+                    f"bf16/bfloat16/fp16/float16, got "
+                    f"'{self.communication_data_type}'")
         # data_types.grad_accum_dtype (later-DeepSpeed key): the GAS
         # accumulator's dtype. The reference's fp16 engine accumulates in
         # half precision the same way (fp16 flat buffers); fp32 stays the
@@ -608,6 +675,7 @@ class DeepSpeedTPUConfig:
         self.tensorboard = TensorboardConfig.from_dict(d.get(C.TENSORBOARD))
         self.telemetry = TelemetryConfig.from_dict(d.get(C.TELEMETRY))
         self.resilience = ResilienceConfig.from_dict(d.get(C.RESILIENCE))
+        self.comm = CommConfig.from_dict(d.get(C.COMM))
         self.guardrails = GuardrailsConfig.from_dict(d.get(C.GUARDRAILS))
         self.sparse_attention = d.get(C.SPARSE_ATTENTION)
         self.pipeline = dict(d.get(C.PIPELINE, {}))

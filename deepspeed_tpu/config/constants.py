@@ -286,3 +286,17 @@ MESH_SLICES = "slices"
 #############################################
 COMMUNICATION_DATA_TYPE = "communication_data_type"
 COMPRESSED_ALLREDUCE = "compressed_allreduce"
+
+# comm block — hierarchical quantized gradient sync (comm/grad_sync.py):
+# bucketed ICI reduce-scatter + blockwise-quantized DCN all-reduce.
+COMM = "comm"
+COMM_HIERARCHICAL = "hierarchical"
+# Default OFF: the implicit pjit path stays bit-identical unless the user
+# opts in ("auto" engages on multi-slice meshes, "on" forces).
+COMM_HIERARCHICAL_DEFAULT = "off"             # auto | on | off
+COMM_DCN_QUANT_BITS = "dcn_quant_bits"
+COMM_DCN_QUANT_BITS_DEFAULT = 8               # 8=int8, 16=bf16, 32=fp32
+COMM_QUANT_BLOCK_SIZE = "quant_block_size"
+COMM_QUANT_BLOCK_SIZE_DEFAULT = 1024
+COMM_BUCKET_MB = "bucket_mb"
+COMM_BUCKET_MB_DEFAULT = 16.0

@@ -260,6 +260,50 @@ class PipelineEngine(TPUEngine):
             state, overflow, norm = apply_step(state, lr)
             return state, loss, overflow, norm
 
+        def pipe_grad(compute_params, batches_, key, scale):
+            grad_fn = jax.value_and_grad(pipe_loss, has_aux=True)
+            (_, loss), grads = grad_fn(compute_params, batches_, key,
+                                       scale)
+            return loss, grads
+
+        def train_step_hierarchical(state: TrainState, batches, lr):
+            """The pipe grad path with the explicit hierarchical grad sync
+            (comm/grad_sync.py): the whole pipelined fwd/bwd runs inside
+            the manual={dcn} region on this slice's microbatch shards
+            (microbatched=False — ONE grad_fn call consumes all
+            microbatches), grads bucket + reduce-scatter over ICI,
+            quantize-all-reduce over dcn, and feed the shared apply. Only
+            reachable with pipeline stages == 1 (resolve_hierarchical
+            rejects stages > 1: the pipelined program is its own manual
+            region and shard_map regions do not nest on this jax) — the
+            composition ladder for staged pipelines is documented in
+            docs/PERFORMANCE.md."""
+            plan = self.grad_sync_plan
+            rng, sub = jax.random.split(state.rng)
+            compute_params = precision.cast_params(state.params)
+            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
+            stacked, fb_synced, loss = plan.run_manual_gas(
+                batches=batches, batch_spec=self.batch_spec,
+                compute_params=compute_params, sub=sub, scale=scale,
+                grad_fn=pipe_grad, microbatched=False)
+            grads = plan.sync_grads(stacked, fb_synced)
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+            state = state._replace(micro_step=state.micro_step + gas,
+                                   grad_acc=grads, rng=rng)
+            state, overflow, norm = apply_step(state, lr)
+            return state, loss, overflow, norm
+
+        if self._grad_sync_on:
+            from deepspeed_tpu.comm.grad_sync import GradSyncPlan
+            self.grad_sync_plan = GradSyncPlan(
+                cfg.comm, mesh,
+                grad_template=self.state.grad_acc,
+                grad_specs=self.grad_specs,
+                acc_dtype=self.grad_accum_dtype,
+                ici_dtype=self._comm_dtype, gas=1)
+            log_dist(self.grad_sync_plan.describe(), ranks=[0])
+            train_step = train_step_hierarchical
+
         def eval_step(state: TrainState, batches):
             compute_params = precision.cast_params(state.params)
             _, loss = pipe_loss(compute_params, batches, None,

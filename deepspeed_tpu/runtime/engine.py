@@ -235,6 +235,36 @@ class TPUEngine:
                          f"{self._offload_param_cfg.device} optimizer tier",
                          ranks=[0])
 
+        # --- gradient-sync strategy (comm/grad_sync.py) ---------------------
+        # Hierarchical quantized sync: bucketed ICI reduce-scatter in the
+        # communication_data_type + blockwise-int8 (or bf16/fp32) DCN
+        # all-reduce, replacing the implicit full-precision pjit resharding
+        # on multi-slice meshes. `off` (and unresolved `auto`) keeps the
+        # pre-existing step functions bit-identical.
+        from deepspeed_tpu.comm.grad_sync import (comm_dtype_from_config,
+                                                  resolve_hierarchical)
+        from deepspeed_tpu.parallel.mesh import PIPE_AXIS
+        self._comm_dtype = comm_dtype_from_config(
+            config.communication_data_type)
+        self._grad_sync_on, sync_reason = resolve_hierarchical(
+            config.comm, self.mesh,
+            needs_local_grads=getattr(self.optimizer, "needs_local_grads",
+                                      False),
+            sparse_gradients=(config.sparse_gradients_enabled
+                              or sparse_gradients_handled),
+            pipe_stages=self.mesh.shape.get(PIPE_AXIS, 1))
+        self.grad_sync_plan = None
+        if self._grad_sync_on:
+            log_dist(f"grad_sync: hierarchical sync enabled ({sync_reason})",
+                     ranks=[0])
+        elif (self._comm_dtype is not None
+              and not getattr(self.optimizer, "needs_local_grads", False)):
+            log_dist(
+                "communication_data_type is set but the implicit grad path "
+                "is active — it applies to the hierarchical grad sync "
+                "(comm.hierarchical) and the 1-bit dense pre-reduction only",
+                ranks=[0])
+
         # --- initial state placement ---------------------------------------
         self.state = self._init_state(params, rng_seed)
 
@@ -528,22 +558,27 @@ class TPUEngine:
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         fp16 = cfg.fp16.enabled
-        predivide = cfg.prescale_gradients
         precision = self.precision
-        loss_fn = self.loss_fn
         mesh = self.mesh
 
         grad_shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), self.grad_specs)
+        scaled_loss_fn = self._make_scaled_loss_fn()
 
-        def scaled_loss_fn(compute_params, batch, rng, scale):
-            out = loss_fn(compute_params, batch, rng)
-            loss, aux = (out if isinstance(out, tuple) else (out, None))
-            loss32 = loss.astype(jnp.float32)
-            scaled = loss32 * scale / gas
-            if predivide:
-                scaled = scaled / self.dp_size * cfg.gradient_predivide_factor
-            return scaled, (loss32, aux)
+        def finish_scan(acc):
+            """Overflow/norm scalars on the fully-reduced accumulator —
+            shared by the implicit and hierarchical scan variants."""
+            # fp16 always checks (loss-scaler contract); bf16/fp32 check
+            # only under the guardrails nonfinite-grad opt-in — no perf
+            # tax on the default path.
+            overflow = (has_inf_or_nan(acc)
+                        if fp16 or self._nonfinite_grad_check
+                        else jnp.zeros((), jnp.bool_))
+            # norm in fp32 (a bf16 square-sum overflows at scale; the cast
+            # fuses into the reduction)
+            norm = global_norm(jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), acc))
+            return overflow, norm
 
         def micro_scan(compute_params, rng, batches, scale):
             def body(carry, batch):
@@ -565,19 +600,41 @@ class TPUEngine:
             zeros = jax.lax.with_sharding_constraint(zeros, grad_shardings)
             (acc, rng), losses = jax.lax.scan(body, (zeros, rng), batches)
             acc = jax.lax.with_sharding_constraint(acc, grad_shardings)
-            # fp16 always checks (loss-scaler contract); bf16/fp32 check
-            # only under the guardrails nonfinite-grad opt-in — no perf
-            # tax on the default path.
-            overflow = (has_inf_or_nan(acc)
-                        if fp16 or self._nonfinite_grad_check
-                        else jnp.zeros((), jnp.bool_))
-            # norm in fp32 (a bf16 square-sum overflows at scale; the cast
-            # fuses into the reduction)
-            norm = global_norm(jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32), acc))
+            overflow, norm = finish_scan(acc)
             return acc, rng, jnp.mean(losses), overflow, norm
 
-        self._offload_micro_scan = jax.jit(micro_scan)
+        def micro_scan_hierarchical(compute_params, rng, batches, scale):
+            """The offload tier's device-side scan with the explicit
+            hierarchical grad sync (comm/grad_sync.py): same signature and
+            return contract as micro_scan, so _offload_train_batch's
+            async D2H pipeline is untouched — it just pulls grads whose
+            DCN hop was quantized."""
+            plan = self.grad_sync_plan
+            rng, sub = jax.random.split(rng)
+            stacked, fb_synced, loss = plan.run_manual_gas(
+                batches=batches, batch_spec=self.batch_spec,
+                compute_params=compute_params, sub=sub, scale=scale,
+                grad_fn=self._make_micro_grad())
+            acc = plan.sync_grads(stacked, fb_synced)
+            acc = jax.lax.with_sharding_constraint(acc, grad_shardings)
+            overflow, norm = finish_scan(acc)
+            return acc, rng, loss, overflow, norm
+
+        if self._grad_sync_on:
+            from deepspeed_tpu.comm.grad_sync import GradSyncPlan
+            self.grad_sync_plan = GradSyncPlan(
+                cfg.comm, mesh,
+                grad_template=jax.tree_util.tree_map(
+                    lambda p: jax.ShapeDtypeStruct(
+                        p.shape, self.grad_accum_dtype),
+                    self._compute_params),
+                grad_specs=self.grad_specs,
+                acc_dtype=self.grad_accum_dtype,
+                ici_dtype=self._comm_dtype, gas=gas)
+            log_dist(self.grad_sync_plan.describe(), ranks=[0])
+            self._offload_micro_scan = jax.jit(micro_scan_hierarchical)
+        else:
+            self._offload_micro_scan = jax.jit(micro_scan)
 
         def cast_tree(tree):
             dt = (precision.dtype if precision.mixed else jnp.float32)
@@ -600,6 +657,7 @@ class TPUEngine:
                 return self._offload_cast(placed)
 
         self._offload_place = offload_place
+        loss_fn = self.loss_fn
 
         def eval_step(compute_params, batch):
             out = loss_fn(compute_params, batch, None)
@@ -688,6 +746,41 @@ class TPUEngine:
     # ------------------------------------------------------------------
     # jitted step construction
     # ------------------------------------------------------------------
+    def _make_scaled_loss_fn(self):
+        """loss_fn wrapped with the engine's scaling contract — ONE
+        definition for every builder (standard, offload, hierarchical):
+        fp16 loss scale, /gas for accumulation, optional prescale
+        pre-division (undone in _make_apply_step's unscale). Returns
+        (scaled, (loss32, aux))."""
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        predivide = cfg.prescale_gradients
+        loss_fn = self.loss_fn
+
+        def scaled_loss_fn(compute_params, batch, rng, scale):
+            out = loss_fn(compute_params, batch, rng)
+            loss, aux = (out if isinstance(out, tuple) else (out, None))
+            loss32 = loss.astype(jnp.float32)
+            scaled = loss32 * scale / gas
+            if predivide:
+                scaled = scaled / self.dp_size * cfg.gradient_predivide_factor
+            return scaled, (loss32, aux)
+
+        return scaled_loss_fn
+
+    def _make_micro_grad(self):
+        """One micro-step's (loss, grads) — the grad_fn the hierarchical
+        paths hand to GradSyncPlan.run_manual_gas."""
+        scaled_loss_fn = self._make_scaled_loss_fn()
+
+        def micro_grad(compute_params, batch, key, scale):
+            grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
+            (_, (loss, _)), grads = grad_fn(compute_params, batch, key,
+                                            scale)
+            return loss, grads
+
+        return micro_grad
+
     def _make_apply_step(self):
         """GAS-boundary optimizer apply: unscale → overflow check → clip →
         update → loss-scale update → overflow-skip (≡ reference
@@ -745,25 +838,18 @@ class TPUEngine:
         if getattr(self.optimizer, "needs_local_grads", False):
             self._build_local_grad_step_fns()
             return
+        if self._grad_sync_on:
+            self._build_hierarchical_step_fns()
+            return
         cfg = self.config
-        gas = cfg.gradient_accumulation_steps
         fp16 = cfg.fp16.enabled
-        predivide = cfg.prescale_gradients
         precision = self.precision
         loss_fn = self.loss_fn
         mesh = self.mesh
 
         grad_shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), self.grad_specs)
-
-        def scaled_loss_fn(compute_params, batch, rng, scale):
-            out = loss_fn(compute_params, batch, rng)
-            loss, aux = (out if isinstance(out, tuple) else (out, None))
-            loss32 = loss.astype(jnp.float32)
-            scaled = loss32 * scale / gas
-            if predivide:
-                scaled = scaled / self.dp_size * cfg.gradient_predivide_factor
-            return scaled, (loss32, aux)
+        scaled_loss_fn = self._make_scaled_loss_fn()
 
         def micro_step_inner(state: TrainState, batch, compute_params):
             rng, sub = jax.random.split(state.rng)
@@ -808,7 +894,82 @@ class TPUEngine:
         self._micro_step = jax.jit(micro_step, donate_argnums=donate)
         self._apply_step = jax.jit(apply_step, donate_argnums=donate)
         self._train_step = jax.jit(train_step, donate_argnums=donate)
+        # eval_step deliberately does NOT donate: the train-path jits all
+        # consume `state` and return its successor (the engine reassigns
+        # self.state from the output), but eval reads state.params by
+        # value and returns only the loss — donating would delete the
+        # live self.state buffers the next train step still needs. The
+        # batch arg is no safer to donate: put_batch returns caller
+        # arrays unchanged when they are already placed, so donation
+        # would free buffers the caller may reuse.
         self._eval_step = jax.jit(eval_step)
+
+    def _build_hierarchical_step_fns(self) -> None:
+        """Step functions with the explicit hierarchical grad sync
+        (comm/grad_sync.py, docs/PERFORMANCE.md): the GAS fwd/bwd scan
+        runs inside a shard_map manual over ONLY the `dcn` axis (ZeRO
+        placement and TP specs stay GSPMD-auto), accumulating each
+        micro-step's grads as flat buckets reduce-scattered over the ICI
+        `data` axis in the communication_data_type; at the boundary the
+        scattered shards all-reduce across slices with blockwise int8
+        (or bf16/fp32 passthrough) quantization in a manual={dcn, data}
+        region, all-gather back, and feed the unchanged optimizer apply.
+
+        Like the other fused-only tiers (1-bit, offload), reference-style
+        forward/backward/step loops ride the stash-and-fuse shim."""
+        from deepspeed_tpu.comm.grad_sync import GradSyncPlan
+
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        fp16 = cfg.fp16.enabled
+        precision = self.precision
+        loss_fn = self.loss_fn          # eval_step below
+        mesh = self.mesh
+
+        plan = GradSyncPlan(cfg.comm, mesh,
+                            grad_template=self.state.grad_acc,
+                            grad_specs=self.grad_specs,
+                            acc_dtype=self.grad_accum_dtype,
+                            ici_dtype=self._comm_dtype, gas=gas)
+        self.grad_sync_plan = plan
+        log_dist(plan.describe(), ranks=[0])
+
+        grad_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self.grad_specs)
+        apply_step = self._make_apply_step()
+        # Note on scaling: inside the dcn-manual region the batch is this
+        # slice's shard, so loss_fn's mean carries a dcn-size-times-larger
+        # per-sample coefficient; the plan's dcn mean divides it back
+        # (exactly, for power-of-two slice counts).
+        micro_grad = self._make_micro_grad()
+
+        def train_step(state: TrainState, batches, lr):
+            rng, sub = jax.random.split(state.rng)
+            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
+            compute_params = precision.cast_params(state.params)
+            stacked, fb_synced, loss = plan.run_manual_gas(
+                batches=batches, batch_spec=self.batch_spec,
+                compute_params=compute_params, sub=sub, scale=scale,
+                grad_fn=micro_grad)
+            grads = plan.sync_grads(stacked, fb_synced)
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+            state = state._replace(micro_step=state.micro_step + gas,
+                                   grad_acc=grads, rng=rng)
+            state, overflow, norm = apply_step(state, lr)
+            return state, loss, overflow, norm
+
+        def eval_step(state: TrainState, batch):
+            compute_params = precision.cast_params(state.params)
+            out = loss_fn(compute_params, batch, None)
+            loss, aux = (out if isinstance(out, tuple) else (out, None))
+            return loss.astype(jnp.float32), aux
+
+        donate = (0,) if self._donate else ()
+        self._train_step = jax.jit(train_step, donate_argnums=donate)
+        # No donation for eval: see the note in _build_step_fns.
+        self._eval_step = jax.jit(eval_step)
+        self._micro_step = None
+        self._apply_step = None
 
     # -- local-grad (1-bit) path: overridable pieces -----------------------
     def _local_grad_axes(self):
@@ -939,9 +1100,19 @@ class TPUEngine:
                                   batches)
             if dense_axis is not None:
                 # Dense ICI-local reduction; the optimizer's compressed
-                # collective then runs over the slow axis only.
-                grads = jax.tree_util.tree_map(
-                    lambda g: jax.lax.pmean(g, dense_axis), grads)
+                # collective then runs over the slow axis only. The wire
+                # dtype honors communication_data_type (the ICI reduction
+                # dtype — same knob the hierarchical grad sync uses);
+                # default keeps the gradient's native dtype.
+                comm_dt = self._comm_dtype
+
+                def dense_reduce(g):
+                    if comm_dt is not None and g.dtype != comm_dt:
+                        return jax.lax.pmean(
+                            g.astype(comm_dt), dense_axis).astype(g.dtype)
+                    return jax.lax.pmean(g, dense_axis)
+
+                grads = jax.tree_util.tree_map(dense_reduce, grads)
             norm = jnp.float32(0.0)
             if cfg.gradient_clipping > 0.0:
                 # Global-norm clip BEFORE the optimizer's own collective
@@ -1270,6 +1441,11 @@ class TPUEngine:
                 stats.get("peak_bytes_in_use", 0), step=self.global_steps)
             tel.registry.gauge("engine/hbm_bytes_in_use").set(
                 stats.get("bytes_in_use", 0), step=self.global_steps)
+        if self.grad_sync_plan is not None:
+            # comm/bytes_dcn, comm/bytes_ici, comm/compression_ratio —
+            # modeled from the plan shape (no device sync; see
+            # docs/OBSERVABILITY.md "Gradient-sync metrics").
+            self.grad_sync_plan.emit_telemetry(tel, self.global_steps)
         if self.global_steps % self.steps_per_print == 0:
             tel.flush()
 

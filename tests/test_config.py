@@ -116,3 +116,52 @@ def test_zero2_with_pipeline_raises():
         DeepSpeedTPUConfig(
             {"train_batch_size": 8, "mesh": {"pipe": 2},
              "zero_optimization": {"stage": 2}}, world_size=8)
+
+
+def test_comm_block_defaults():
+    c = DeepSpeedTPUConfig({"train_batch_size": 8}, world_size=8)
+    assert c.comm.hierarchical == "off"
+    assert c.comm.dcn_quant_bits == 8
+    assert c.comm.quant_block_size == 1024
+    assert c.comm.bucket_mb == 16.0
+    assert c.communication_data_type is None
+
+
+def test_comm_block_parsing():
+    c = DeepSpeedTPUConfig(
+        {"train_batch_size": 8,
+         "comm": {"hierarchical": "ON", "dcn_quant_bits": 16,
+                  "quant_block_size": 256, "bucket_mb": 4}},
+        world_size=8)
+    assert c.comm.hierarchical == "on"
+    assert c.comm.dcn_quant_bits == 16
+    assert c.comm.quant_block_size == 256
+    assert c.comm.bucket_mb == 4.0
+
+
+@pytest.mark.parametrize("block,match", [
+    ({"hierarchical": "sometimes"}, "auto|on|off"),
+    ({"dcn_quant_bits": 4}, "dcn_quant_bits"),
+    ({"quant_block_size": 0}, "quant_block_size"),
+    ({"bucket_mb": -1}, "bucket_mb"),
+])
+def test_comm_block_invalid_raises(block, match):
+    with pytest.raises(ConfigError, match=match):
+        DeepSpeedTPUConfig({"train_batch_size": 8, "comm": block},
+                           world_size=8)
+
+
+@pytest.mark.parametrize("value", ["fp32", "float32", "bf16", "bfloat16",
+                                   "fp16", "float16", "BF16"])
+def test_communication_data_type_valid(value):
+    c = DeepSpeedTPUConfig(
+        {"train_batch_size": 8, "communication_data_type": value},
+        world_size=8)
+    assert c.communication_data_type == value.lower()
+
+
+def test_communication_data_type_invalid_raises():
+    with pytest.raises(ConfigError, match="communication_data_type"):
+        DeepSpeedTPUConfig(
+            {"train_batch_size": 8, "communication_data_type": "int7"},
+            world_size=8)
