@@ -29,6 +29,11 @@ import traceback
 import jax
 import numpy as np
 
+# ONE source of truth for MFU math + per-chip peak TFLOP/s tables
+# (telemetry/goodput.py's engine/mfu gauge divides by the same numbers).
+from deepspeed_tpu.profiling.flops_profiler import mfu as compute_mfu
+from deepspeed_tpu.profiling.flops_profiler import peak_tflops
+
 # Partial results land here after EVERY completed section so a transient
 # tunnel failure (the round-4 driver run died on a dropped remote_compile
 # connection ~2 min in) can never zero the whole record: whatever rows
@@ -44,9 +49,6 @@ BASELINE_BERT_SEQ512 = 52.0    # samples/s, 1x V100
 # converted to GPT-2-small tokens as the comparable bar: 64e12 / (6*124e6)
 # ~= 86k tokens/s.
 BASELINE_GPT2_TOKENS = 86000.0
-
-# Peak bf16 matmul throughput per chip kind, for the MFU print.
-PEAK_TFLOPS = {"TPU v5 lite": 197.0, "TPU v4": 275.0, "TPU v6 lite": 918.0}
 
 
 def log(msg):
@@ -132,7 +134,7 @@ def bench_bert(seq, micro_bs, gas, steps, warmup, on_tpu):
     flops = train_flops_per_step(n_params, samples, seq,
                                  cfg.hidden_size, cfg.num_layers)
     tflops = flops / dt / 1e12 / n_chips
-    return sps, tflops, n_params, samples / dt_med / n_chips
+    return sps, tflops, n_params, samples / dt_med / n_chips, flops, dt
 
 
 def bench_gpt2(steps, warmup, on_tpu, dropout_rate=0.0):
@@ -168,7 +170,7 @@ def bench_gpt2(steps, warmup, on_tpu, dropout_rate=0.0):
     flops = train_flops_per_step(n_params, gas * bs * steps, seq,
                                  cfg.hidden_size, cfg.num_layers)
     tflops = flops / dt / 1e12 / n_chips
-    return tokens_per_sec, tflops, tokens / dt_med / n_chips
+    return tokens_per_sec, tflops, tokens / dt_med / n_chips, flops, dt
 
 
 def bench_gpt2_long(steps, warmup, sparse: bool, seq=16384):
@@ -325,18 +327,23 @@ def main():
         print(json.dumps(result))
         sys.exit(1)
     on_tpu = platform == "tpu"
-    peak = PEAK_TFLOPS.get(getattr(dev, "device_kind", ""), 197.0)
+    peak = peak_tflops(getattr(dev, "device_kind", ""), dtype="bfloat16")
+    n_chips_all = len(jax.devices())
     # Environment block: the conditions the rows were measured under, so
     # numbers stay comparable across PRs. telemetry is explicitly "off" —
     # none of the bench configs enable the telemetry block, so no sync'd
     # spans or per-step gauges perturb the timed windows; a future PR that
-    # benches with telemetry on must say so here.
+    # benches with telemetry on must say so here. goodput rides telemetry
+    # (telemetry/goodput.py), so it is off too — its accountant is pure
+    # host clock reads, but the env block records the whole config anyway.
     result["environment"] = {
         "platform": platform,
         "device_kind": getattr(dev, "device_kind", ""),
-        "devices": len(jax.devices()),
+        "devices": n_chips_all,
         "jax": jax.__version__,
         "telemetry": "off",
+        "goodput": "off",
+        "peak_tflops_per_chip": peak,
         # Gradient-sync strategy the rows were measured under
         # (comm/grad_sync.py): none of the bench configs set a comm
         # block, so the implicit full-precision path is timed. A future
@@ -356,28 +363,32 @@ def main():
 
     def sec_bert128():
         t0 = time.time()
-        sps128, tf128, n_params, sps128_med = bench_bert(
+        sps128, tf128, n_params, sps128_med, flops, dt = bench_bert(
             seq=128 if on_tpu else 64, micro_bs=32 if on_tpu else 8,
             gas=8 if on_tpu else 1, steps=steps, warmup=warmup, on_tpu=on_tpu)
+        mfu128 = compute_mfu(flops, dt, n_chips=n_chips_all,
+                             peak_tflops_per_chip=peak)
         log(f"[bench] BERT-large seq128: {sps128:.1f} samples/s/chip, "
-            f"{tf128:.1f} TFLOP/s, MFU {tf128 / peak:.1%} "
+            f"{tf128:.1f} TFLOP/s, MFU {mfu128:.1%} "
             f"({n_params / 1e6:.0f}M params, "
             f"setup+run {time.time() - t0:.0f}s)")
         result["value"] = round(sps128, 2)
         result["vs_baseline"] = round(sps128 / BASELINE_BERT_SEQ128, 4)
         result["tflops"] = round(tf128, 1)
-        result["mfu"] = round(tf128 / peak, 4)
+        result["mfu"] = round(mfu128, 4)
         # median-of-windows companion (ADVICE r3): drift-inclusive view of
         # the same run; `value`/`vs_baseline` stay best-of-windows.
         result["value_median_window"] = round(sps128_med, 2)
 
     def sec_bert512():
         t0 = time.time()
-        sps512, tf512, _, sps512_med = bench_bert(
+        sps512, tf512, _, sps512_med, flops, dt = bench_bert(
             seq=512, micro_bs=8, gas=8, steps=steps, warmup=warmup,
             on_tpu=on_tpu)
+        mfu512 = compute_mfu(flops, dt, n_chips=n_chips_all,
+                             peak_tflops_per_chip=peak)
         log(f"[bench] BERT-large seq512: {sps512:.1f} samples/s/chip, "
-            f"{tf512:.1f} TFLOP/s, MFU {tf512 / peak:.1%} "
+            f"{tf512:.1f} TFLOP/s, MFU {mfu512:.1%} "
             f"({time.time() - t0:.0f}s)")
         result["bert_seq512_samples_per_sec"] = round(sps512, 2)
         result["bert_seq512_vs_baseline"] = round(
@@ -386,26 +397,31 @@ def main():
 
     def sec_gpt2():
         t0 = time.time()
-        gpt2_tps, gpt2_tf, gpt2_tps_med = bench_gpt2(steps, warmup, on_tpu)
+        gpt2_tps, gpt2_tf, gpt2_tps_med, flops, dt = bench_gpt2(
+            steps, warmup, on_tpu)
+        gpt2_mfu = compute_mfu(flops, dt, n_chips=n_chips_all,
+                               peak_tflops_per_chip=peak)
         log(f"[bench] GPT-2 seq512: {gpt2_tps:.0f} tokens/s/chip, "
-            f"{gpt2_tf:.1f} TFLOP/s, MFU {gpt2_tf / peak:.1%} "
+            f"{gpt2_tf:.1f} TFLOP/s, MFU {gpt2_mfu:.1%} "
             f"({time.time() - t0:.0f}s)")
         result["gpt2_tokens_per_sec"] = round(gpt2_tps, 0)
         result["gpt2_vs_baseline"] = round(gpt2_tps / BASELINE_GPT2_TOKENS, 4)
         result["gpt2_median_window"] = round(gpt2_tps_med, 0)
-        result["gpt2_mfu"] = round(gpt2_tf / peak, 4)
+        result["gpt2_mfu"] = round(gpt2_mfu, 4)
 
     def sec_gpt2_dropout():
         # Dropout-on variant (r2 VERDICT task 4 "done" criterion): real
         # pretraining configs keep the flash path via in-kernel dropout.
         t0 = time.time()
-        gpt2_do_tps, gpt2_do_tf, _ = bench_gpt2(steps, warmup, on_tpu,
-                                                dropout_rate=0.1)
+        gpt2_do_tps, gpt2_do_tf, _, flops, dt = bench_gpt2(
+            steps, warmup, on_tpu, dropout_rate=0.1)
+        do_mfu = compute_mfu(flops, dt, n_chips=n_chips_all,
+                             peak_tflops_per_chip=peak)
         log(f"[bench] GPT-2 seq512 dropout=0.1: {gpt2_do_tps:.0f} "
             f"tokens/s/chip, {gpt2_do_tf:.1f} TFLOP/s, MFU "
-            f"{gpt2_do_tf / peak:.1%} ({time.time() - t0:.0f}s)")
+            f"{do_mfu:.1%} ({time.time() - t0:.0f}s)")
         result["gpt2_dropout_tokens_per_sec"] = round(gpt2_do_tps, 0)
-        result["gpt2_dropout_mfu"] = round(gpt2_do_tf / peak, 4)
+        result["gpt2_dropout_mfu"] = round(do_mfu, 4)
 
     def sec_long():
         t0 = time.time()

@@ -528,6 +528,10 @@ class TelemetryConfig:
     metrics: TelemetryMetricsConfig = field(
         default_factory=TelemetryMetricsConfig)
     recompile_detection: bool = C.TELEMETRY_RECOMPILE_DEFAULT
+    # Goodput accounting (telemetry/goodput.py): wall-clock attribution,
+    # engine/mfu and per-attempt run manifests. Pure host clock reads —
+    # no device syncs even when on — so it defaults on with telemetry.
+    goodput: bool = C.TELEMETRY_GOODPUT_DEFAULT
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
@@ -540,6 +544,8 @@ class TelemetryConfig:
                 d.get(C.TELEMETRY_METRICS)),
             recompile_detection=bool(_get(d, C.TELEMETRY_RECOMPILE,
                                           C.TELEMETRY_RECOMPILE_DEFAULT)),
+            goodput=bool(_get(d, C.TELEMETRY_GOODPUT,
+                              C.TELEMETRY_GOODPUT_DEFAULT)),
         )
         if cfg.enabled and not cfg.dir:
             raise ConfigError(

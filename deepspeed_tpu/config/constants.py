@@ -171,6 +171,12 @@ TELEMETRY_METRICS_FILE = "file"
 TELEMETRY_METRICS_FILE_DEFAULT = "metrics.jsonl"
 TELEMETRY_RECOMPILE = "recompile_detection"
 TELEMETRY_RECOMPILE_DEFAULT = True
+# Goodput accounting (telemetry/goodput.py): run-level wall-clock
+# attribution + MFU + per-attempt run manifests. Rides the telemetry
+# block; default ON when telemetry is enabled (it adds zero device syncs
+# — pure host clock reads).
+TELEMETRY_GOODPUT = "goodput"
+TELEMETRY_GOODPUT_DEFAULT = True
 
 #############################################
 # Logging / misc

@@ -69,9 +69,11 @@ class Guardrails:
     step's (loss, overflow, grad-norm) device scalars (detector + policy).
     """
 
-    def __init__(self, cfg, telemetry=None, metrics_path: Optional[str] = None):
+    def __init__(self, cfg, telemetry=None, metrics_path: Optional[str] = None,
+                 goodput=None):
         self.cfg = cfg
         self.telemetry = telemetry
+        self.goodput = goodput
         self.detector = AnomalyDetector(
             zscore_threshold=cfg.detector.zscore_threshold,
             warmup_steps=cfg.detector.warmup_steps,
@@ -147,7 +149,15 @@ class Guardrails:
                 # convert a cheap rollback into a watchdog kill.
                 if self.watchdog is not None:
                     self.watchdog.suspend()
-                summary = self.policy.rollback(engine, self._data_skip_fn)
+                if self.goodput is not None:
+                    # The restore (+ loader skip) is lost time with its own
+                    # goodput category; the re-executed steps that follow
+                    # are booked as rollback_replay by the engine.
+                    with self.goodput.measure("rollback_restore"):
+                        summary = self.policy.rollback(engine,
+                                                       self._data_skip_fn)
+                else:
+                    summary = self.policy.rollback(engine, self._data_skip_fn)
                 self._emit_rollback(step, summary)
                 return True
         elif verdict.kind == OK:
@@ -194,9 +204,11 @@ class Guardrails:
 
 
 def build_guardrails(gcfg, telemetry=None,
-                     metrics_path: Optional[str] = None) -> Optional[Guardrails]:
+                     metrics_path: Optional[str] = None,
+                     goodput=None) -> Optional[Guardrails]:
     """``None`` for a disabled block — the engine's hooks gate on ``is
     None``, which is the whole zero-cost-when-disabled story."""
     if gcfg is None or not gcfg.enabled:
         return None
-    return Guardrails(gcfg, telemetry=telemetry, metrics_path=metrics_path)
+    return Guardrails(gcfg, telemetry=telemetry, metrics_path=metrics_path,
+                      goodput=goodput)

@@ -67,6 +67,12 @@ def parse_args(args=None):
                              "kill (immediate no-backoff restart). Set "
                              "this when the ds-config overrides "
                              "guardrails.watchdog.exit_code; default 113")
+    parser.add_argument("--run_dir", type=str, default=None,
+                        help="Goodput run dir (the job's telemetry.dir): "
+                             "with --auto_resume, each attempt's run "
+                             "manifest there gets its exit rc / restart "
+                             "cause stamped so tools/goodput_report.py "
+                             "can attribute inter-attempt downtime")
     parser.add_argument("user_script", type=str,
                         help="User training script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -261,6 +267,7 @@ def main(args=None):
             sys.exit(Supervisor(cmd, max_restarts=args.max_restarts,
                                 max_backoff=args.max_backoff,
                                 immediate_restart_rcs=immediate,
+                                run_dir=args.run_dir,
                                 env=env).run())
         result = subprocess.run(cmd, env={**os.environ, **env})
         sys.exit(result.returncode)
@@ -302,7 +309,29 @@ def main(args=None):
 
         return babysit(procs, on_failure=remote_kill)
 
-    rc = launch_once({})
+    from deepspeed_tpu.telemetry.goodput import (ATTEMPT_START_WALL_ENV,
+                                                 classify_exit,
+                                                 finalize_attempt_manifests)
+
+    def finalize_attempt(attempt: int, rc_: int, start_wall: float) -> None:
+        """Stamp the attempt's goodput run manifests with its fate
+        (best-effort — accounting must never break the recovery loop)."""
+        if not args.run_dir:
+            return
+        from deepspeed_tpu.config.constants import \
+            GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT
+        watchdog = (args.watchdog_rc,) if args.watchdog_rc is not None \
+            else (GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT,)
+        try:
+            finalize_attempt_manifests(args.run_dir, attempt, rc_,
+                                       classify_exit(rc_, watchdog),
+                                       start_wall, time.time())
+        except Exception as e:  # noqa: BLE001
+            logger.warning("goodput manifest finalize failed: %s", e)
+
+    t_start = time.time()
+    rc = launch_once({ATTEMPT_START_WALL_ENV: repr(t_start)})
+    finalize_attempt(0, rc, t_start)
     restarts = 0
     while rc != 0 and args.auto_resume and restarts < args.max_restarts:
         restarts += 1
@@ -321,7 +350,10 @@ def main(args=None):
                        rc, restarts, args.max_restarts, delay)
         if delay:
             time.sleep(delay)
-        rc = launch_once({RESUME_ATTEMPT_ENV: str(restarts)})
+        t_start = time.time()
+        rc = launch_once({RESUME_ATTEMPT_ENV: str(restarts),
+                          ATTEMPT_START_WALL_ENV: repr(t_start)})
+        finalize_attempt(restarts, rc, t_start)
     sys.exit(rc)
 
 

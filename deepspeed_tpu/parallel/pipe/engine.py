@@ -369,6 +369,14 @@ class PipelineEngine(TPUEngine):
             if sp.duration:
                 reg.gauge("pipe/bubble_time_sec").set(
                     sp.duration * frac, step=self.global_steps)
+                if self.goodput is not None:
+                    # Analytic bubble seconds as a goodput auxiliary gauge
+                    # (goodput/pipe_bubble_sec): schedule-idle time hiding
+                    # INSIDE productive_step — not part of the wall-clock
+                    # partition, but exactly the slice the overlap work on
+                    # the ROADMAP would claw back.
+                    self.goodput.note_aux("pipe_bubble_sec",
+                                          sp.duration * frac)
         if self.global_steps % self.steps_per_print == 0:
             log_dist(f"step={self.global_steps} loss={float(loss):.4f}",
                      ranks=[0])

@@ -24,6 +24,59 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+# Peak matmul throughput per chip kind and dtype (TFLOP/s). bf16 numbers
+# are the published MXU peaks; fp32 runs the MXU in multi-pass mode at
+# half rate. fp16 inputs go through the same bf16 MXU path on TPU. The
+# table is the ONE source every MFU in the tree divides by —
+# bench.py, engine/mfu (telemetry/goodput.py) and tools/goodput_report
+# all route through :func:`mfu` below.
+TPU_PEAK_TFLOPS = {
+    "TPU v4": {"bfloat16": 275.0, "float32": 137.5},
+    "TPU v5 lite": {"bfloat16": 197.0, "float32": 98.5},
+    "TPU v5p": {"bfloat16": 459.0, "float32": 229.5},
+    "TPU v6 lite": {"bfloat16": 918.0, "float32": 459.0},
+    "TPU v6e": {"bfloat16": 918.0, "float32": 459.0},
+}
+DEFAULT_PEAK_TFLOPS = 197.0  # v5e-class bf16 — the conservative fallback
+
+_DTYPE_ALIASES = {
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "fp32": "float32", "float32": "float32",
+    # fp16 inputs ride the bf16 MXU path on TPU
+    "fp16": "bfloat16", "float16": "bfloat16",
+}
+
+
+def peak_tflops(device_kind: Optional[str] = None,
+                dtype: str = "bfloat16") -> float:
+    """Per-chip peak TFLOP/s for a device kind + compute dtype, with the
+    conservative v5e-class default for unknown kinds (CPU test meshes,
+    future chips)."""
+    dtype = _DTYPE_ALIASES.get(str(dtype).lower(), "bfloat16")
+    kinds = TPU_PEAK_TFLOPS.get(device_kind or "")
+    if kinds is None:
+        base = DEFAULT_PEAK_TFLOPS
+        return base / 2.0 if dtype == "float32" else base
+    return kinds.get(dtype, kinds["bfloat16"])
+
+
+def mfu(flops_per_step: Optional[float], step_time_s: float,
+        n_chips: int = 1, peak_tflops_per_chip: Optional[float] = None,
+        device_kind: Optional[str] = None,
+        dtype: str = "bfloat16") -> float:
+    """Model FLOPs utilisation: ``flops_per_step`` (the WHOLE global
+    step's FLOPs, across all chips) / (step time × chips × per-chip
+    peak). Pass ``peak_tflops_per_chip`` explicitly or let the
+    device-kind/dtype table supply it. Returns 0.0 for degenerate
+    inputs (no FLOPs, non-positive time) rather than raising — MFU is a
+    report field, not a control signal."""
+    if not flops_per_step or flops_per_step <= 0 or step_time_s <= 0:
+        return 0.0
+    if peak_tflops_per_chip is None:
+        peak_tflops_per_chip = peak_tflops(device_kind, dtype)
+    denom = step_time_s * max(int(n_chips), 1) * peak_tflops_per_chip * 1e12
+    return float(flops_per_step) / denom
+
 
 def _count_params(tree: Any) -> int:
     return sum(int(np.prod(x.shape))
@@ -134,6 +187,21 @@ class FlopsProfiler:
             result["achieved_tflops"] = result["flops"] / dt / 1e12
         self.last = result
         return result
+
+    # ------------------------------------------------------------------
+    def mfu(self, step_time_s: float,
+            peak_tflops_per_chip: Optional[float] = None,
+            n_chips: int = 1, flops: Optional[float] = None,
+            device_kind: Optional[str] = None,
+            dtype: str = "bfloat16") -> float:
+        """MFU of the last profiled callable (or explicit ``flops``) at a
+        measured step time — delegates to the module-level :func:`mfu`,
+        the single MFU formula in the tree."""
+        if flops is None:
+            flops = (self.last or {}).get("flops")
+        return mfu(flops, step_time_s, n_chips=n_chips,
+                   peak_tflops_per_chip=peak_tflops_per_chip,
+                   device_kind=device_kind, dtype=dtype)
 
     # ------------------------------------------------------------------
     def print_profile(self, result: Optional[Dict[str, Any]] = None,

@@ -32,6 +32,7 @@ device_put against the new engine's NamedShardings is the reshard the
 cross-replica-sharding paper's weight-update partitioning needs on recovery.
 """
 
+import contextlib
 import hashlib
 import json
 import os
@@ -157,7 +158,8 @@ class AsyncCheckpointManager:
                  async_write: bool = True,
                  fault_plan: Optional[FaultPlan] = None,
                  monitor=None,
-                 telemetry=None):
+                 telemetry=None,
+                 goodput=None):
         if interval < 1:
             raise ValueError("checkpoint interval must be >= 1")
         if keep_last < 1:
@@ -171,6 +173,7 @@ class AsyncCheckpointManager:
         self.fault_plan = fault_plan
         self.monitor = monitor
         self.telemetry = telemetry
+        self.goodput = goodput
         self.stats = {"saved": 0, "dropped": 0, "retries": 0, "failed": 0}
         self.last_error: Optional[BaseException] = None
         os.makedirs(ckpt_dir, exist_ok=True)
@@ -207,11 +210,19 @@ class AsyncCheckpointManager:
         ``last_error`` plus the log (checkpointing must not kill the run
         it exists to protect)."""
         t0 = time.monotonic()
-        with self._span("ckpt_snapshot", step=int(engine.global_steps)):
-            snap = snapshot_engine(engine, client_state=client_state)
+        # Goodput attribution (telemetry/goodput.py): save() runs on the
+        # step path, so the D2H snapshot is step-path time; for a
+        # sync-write manager the inline write below stalls the step too.
+        gp = self.goodput
+        with (gp.measure("ckpt_snapshot") if gp is not None
+              else contextlib.nullcontext()):
+            with self._span("ckpt_snapshot", step=int(engine.global_steps)):
+                snap = snapshot_engine(engine, client_state=client_state)
         snap.meta["snapshot_sec"] = round(time.monotonic() - t0, 6)
         if not self.async_write:
-            self._write_with_retries(snap)
+            with (gp.measure("ckpt_write_stall") if gp is not None
+                  else contextlib.nullcontext()):
+                self._write_with_retries(snap)
             return
         with self._cv:
             if self._closed:
@@ -230,10 +241,14 @@ class AsyncCheckpointManager:
             self.wait()
 
     def wait(self) -> None:
-        """Drain: returns once no snapshot is pending or being written."""
-        with self._cv:
-            self._cv.wait_for(
-                lambda: self._pending is None and not self._writing)
+        """Drain: returns once no snapshot is pending or being written.
+        The caller genuinely blocks on checkpoint I/O here, so the wait is
+        goodput-attributed as ckpt_write_stall."""
+        with (self.goodput.measure("ckpt_write_stall")
+              if self.goodput is not None else contextlib.nullcontext()):
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._pending is None and not self._writing)
 
     def _drain_at_exit(self) -> None:
         try:

@@ -55,6 +55,7 @@ class Supervisor:
                  jitter: float = 0.25,
                  immediate_restart_rcs: Optional[Iterable[int]] = None,
                  ckpt_dir: Optional[str] = None,
+                 run_dir: Optional[str] = None,
                  available_worlds: Optional[Callable[[int], int]] = None):
         if max_restarts < 0:
             raise ValueError("max_restarts must be >= 0")
@@ -68,6 +69,11 @@ class Supervisor:
             immediate_restart_rcs if immediate_restart_rcs is not None
             else (GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT,))
         self.ckpt_dir = ckpt_dir
+        # Goodput run dir (the child's telemetry.dir): when set, each
+        # attempt's run manifest gets its exit rc / restart cause stamped
+        # post-mortem — the child rarely gets to write those itself
+        # (telemetry/goodput.py; tools/goodput_report.py merges them).
+        self.run_dir = run_dir
         self.available_worlds = available_worlds
         self.restarts = 0
         self.immediate_restarts = 0
@@ -80,11 +86,33 @@ class Supervisor:
             self.metrics = MetricsJSONL(os.path.join(ckpt_dir, METRICS_FILE))
 
     def _child_env(self, attempt: int) -> Dict[str, str]:
+        from deepspeed_tpu.telemetry.goodput import ATTEMPT_START_WALL_ENV
         env = {**os.environ, **self.env,
-               RESUME_ATTEMPT_ENV: str(attempt)}
+               RESUME_ATTEMPT_ENV: str(attempt),
+               # Spawn wall time: the child's goodput accountant backdates
+               # the attempt to it, so interpreter start-up (imports) is
+               # attributed to init_restore instead of vanishing.
+               ATTEMPT_START_WALL_ENV: repr(time.time())}
         if self.available_worlds is not None:
             env[ELASTIC_WORLD_ENV] = str(self.available_worlds(attempt))
         return env
+
+    def _finalize_attempt(self, attempt: int, rc: int,
+                          start_wall: float) -> None:
+        """Stamp the attempt's run manifest(s) with its fate (goodput
+        cross-attempt reporting). Best-effort: accounting must never take
+        down the recovery loop."""
+        if not self.run_dir:
+            return
+        from deepspeed_tpu.telemetry.goodput import (classify_exit,
+                                                     finalize_attempt_manifests)
+        try:
+            finalize_attempt_manifests(
+                self.run_dir, attempt, rc,
+                classify_exit(rc, self.immediate_restart_rcs),
+                start_wall, time.time())
+        except Exception as e:  # noqa: BLE001
+            logger.warning("supervisor: manifest finalize failed: %s", e)
 
     def run(self) -> int:
         """Run until clean exit or restart budget exhausted; returns the
@@ -93,6 +121,7 @@ class Supervisor:
         while True:
             logger.info("supervisor: launching attempt %d: %s", attempt,
                         " ".join(self.cmd))
+            start_wall = time.time()
             proc = subprocess.Popen(self.cmd, env=self._child_env(attempt))
             try:
                 rc = proc.wait()
@@ -104,6 +133,7 @@ class Supervisor:
                     proc.kill()
                 raise
             self.exit_codes.append(rc)
+            self._finalize_attempt(attempt, rc, start_wall)
             if rc == 0:
                 if self.metrics is not None:
                     self.metrics.add_scalar(
@@ -158,6 +188,10 @@ def supervise_main(argv: Optional[List[str]] = None) -> int:
                          "when the ds-config overrides "
                          "guardrails.watchdog.exit_code")
     ap.add_argument("--checkpoint_dir", type=str, default=None)
+    ap.add_argument("--run_dir", type=str, default=None,
+                    help="Goodput run dir (the child's telemetry.dir): "
+                         "attempt run manifests there get exit rc / "
+                         "restart cause stamped for goodput_report")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="training command (prefix with --)")
     args = ap.parse_args(argv)
@@ -167,7 +201,8 @@ def supervise_main(argv: Optional[List[str]] = None) -> int:
     return Supervisor(cmd, max_restarts=args.max_restarts,
                       backoff=args.backoff, max_backoff=args.max_backoff,
                       immediate_restart_rcs=args.immediate_rc,
-                      ckpt_dir=args.checkpoint_dir).run()
+                      ckpt_dir=args.checkpoint_dir,
+                      run_dir=args.run_dir).run()
 
 
 if __name__ == "__main__":

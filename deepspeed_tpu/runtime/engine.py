@@ -315,6 +315,19 @@ class TPUEngine:
         from deepspeed_tpu.telemetry import build_telemetry
         self.telemetry = build_telemetry(config.telemetry,
                                          monitor=self.monitor)
+        # Goodput accounting (telemetry/goodput.py): attributes every
+        # wall-clock second of this attempt to a category and persists the
+        # per-attempt run manifest. Disabled => None, and every hook below
+        # is one attribute check — zero added syncs/fetches, same contract
+        # as guardrails.
+        from deepspeed_tpu.telemetry.goodput import (build_goodput,
+                                                     config_hash)
+        self.goodput = build_goodput(
+            config.telemetry, telemetry=self.telemetry,
+            cfg_hash=config_hash(getattr(config, "_param_dict", None)))
+        # Highest step a rollback rewound past: steps re-committed at or
+        # below it are replay (real compute, no net progress).
+        self._goodput_replay_until = 0
         self.moq = None
         if config.quantize_training.get("enabled", False):
             if self._offload_cfg.enabled and self._offload_cfg.device == "nvme":
@@ -362,7 +375,8 @@ class TPUEngine:
                 async_write=rcfg.checkpoint.async_write,
                 fault_plan=self.fault_plan,
                 monitor=self.monitor,
-                telemetry=self.telemetry)
+                telemetry=self.telemetry,
+                goodput=self.goodput)
         # --- guardrails: anomaly detection + in-memory rollback + watchdog --
         # (guardrails/; docs/RESILIENCE.md "Guardrails"). build_guardrails
         # returns None for a disabled block, and every engine hook gates on
@@ -373,7 +387,8 @@ class TPUEngine:
         self.guardrails = build_guardrails(
             config.guardrails, telemetry=self.telemetry,
             metrics_path=(os.path.join(tcfg.dir, tcfg.metrics.file)
-                          if tcfg.enabled else None))
+                          if tcfg.enabled else None),
+            goodput=self.goodput)
         # Monotonic count of dispatched optimizer-step attempts. Unlike
         # global_steps it never rewinds on rollback: data-borne fault
         # injection (FaultPlan nan_loss/hang) keys on it so a rolled-back
@@ -1307,6 +1322,9 @@ class TPUEngine:
         if self._micro_step is None:
             return self._compat_forward(batch)
         tel = self.telemetry
+        g = self.goodput
+        if g is not None:
+            g.mark_gap()
         if self.wall_clock_breakdown:
             self.timers("forward").start()
         if self.progressive_layer_drop is not None and isinstance(batch, dict):
@@ -1319,10 +1337,24 @@ class TPUEngine:
             batch = self.put_batch(batch)
         if self.wall_clock_breakdown:
             self.timers("dataloader").stop()
-        tel.check_recompile("engine.micro_step", batch,
-                            step=self.global_steps)
+        if g is not None:
+            g.mark("data_stall")
+        status = tel.check_recompile("engine.micro_step", batch,
+                                     step=self.global_steps)
         with tel.span("forward", step=self.global_steps):
             self.state, loss, _ = self._micro_step(self.state, batch)
+        if g is not None:
+            # Same classification as _goodput_step_mark: micro-steps
+            # re-run after a rollback rewind (the upcoming committed step
+            # global_steps+1 is at or below the high-water mark) are
+            # replay, not productive — the fwd+bwd here is the dominant
+            # share of step time on this API.
+            if status in ("compile", "retrace"):
+                g.mark("recompile")
+            elif self.global_steps < self._goodput_replay_until:
+                g.mark("rollback_replay")
+            else:
+                g.mark("productive_step")
         self._last_loss = loss
         if self.wall_clock_breakdown:
             self.timers("forward").stop()
@@ -1411,6 +1443,7 @@ class TPUEngine:
         finally:
             if gr is not None:
                 gr.step_end()
+        self._goodput_step_mark(None)
         if self.global_steps % self.steps_per_print == 0:
             loss = float(self._last_loss) if self._last_loss is not None else float("nan")
             log_dist(f"step={self.global_steps} loss={loss:.4f} "
@@ -1424,30 +1457,98 @@ class TPUEngine:
 
     def _emit_step_telemetry(self) -> None:
         """Per-step registry emission: HBM watermark gauges (peak +
-        in-use, the OOM-margin signal), default step stamp, and a periodic
-        trace-file flush (atomic rewrite at steps_per_print cadence so a
-        preemption keeps a recent trace without O(steps^2) rewriting)."""
+        in-use, the OOM-margin signal), goodput category gauges, default
+        step stamp, and a periodic trace-file + run-manifest flush (atomic
+        rewrites at steps_per_print cadence so a preemption keeps a recent
+        trace without O(steps^2) rewriting)."""
         tel = self.telemetry
         if not tel.enabled:
             return
         tel.set_step(self.global_steps)
-        stats = None
+        # ALL local devices, not just [0]: a multi-chip host's OOM margin
+        # is set by its worst chip, and total in-use is the host's real
+        # footprint. peak = max over devices, in_use = sum; rows carry the
+        # device count so dashboards can tell a 1-chip host from an 8-chip.
+        peaks, in_use = [], []
         try:
-            stats = jax.local_devices()[0].memory_stats()
-        except Exception:  # noqa: BLE001 — CPU backends may not report
-            stats = None
-        if stats:
+            devices = jax.local_devices()
+        except Exception:  # noqa: BLE001 — backend may be gone at teardown
+            devices = []
+        for dev in devices:
+            try:
+                stats = dev.memory_stats()
+            except Exception:  # noqa: BLE001 — CPU backends may not report
+                stats = None
+            if stats:
+                peaks.append(stats.get("peak_bytes_in_use", 0))
+                in_use.append(stats.get("bytes_in_use", 0))
+        if peaks:
             tel.registry.gauge("engine/hbm_peak_bytes").set(
-                stats.get("peak_bytes_in_use", 0), step=self.global_steps)
+                max(peaks), step=self.global_steps, devices=len(peaks))
             tel.registry.gauge("engine/hbm_bytes_in_use").set(
-                stats.get("bytes_in_use", 0), step=self.global_steps)
+                sum(in_use), step=self.global_steps, devices=len(peaks))
         if self.grad_sync_plan is not None:
             # comm/bytes_dcn, comm/bytes_ici, comm/compression_ratio —
             # modeled from the plan shape (no device sync; see
             # docs/OBSERVABILITY.md "Gradient-sync metrics").
             self.grad_sync_plan.emit_telemetry(tel, self.global_steps)
+        if self.goodput is not None:
+            self.goodput.emit(self.global_steps)
         if self.global_steps % self.steps_per_print == 0:
             tel.flush()
+            if self.goodput is not None:
+                # Crash-freshness: a SIGTERM'd attempt keeps a manifest no
+                # older than one flush cadence.
+                self.goodput.write_manifest()
+
+    def _goodput_step_mark(self, status) -> None:
+        """End-of-step attribution: recompile when the detector saw this
+        dispatch trace/compile, rollback_replay while re-earning ground a
+        rollback gave up, productive_step otherwise."""
+        g = self.goodput
+        if g is None:
+            return
+        if status in ("compile", "retrace"):
+            cat = "recompile"
+        elif self.global_steps <= self._goodput_replay_until:
+            cat = "rollback_replay"
+        else:
+            cat = "productive_step"
+        g.step_mark(cat, self.global_steps)
+
+    def _maybe_goodput_cost_analysis(self, batches, lr) -> None:
+        """Feed the accountant the step function's XLA cost-analysis FLOPs
+        — ONCE per engine (re-attempted never, success or fail), so
+        ``engine/mfu`` needs no per-step analysis. Uses
+        ``Lowered.cost_analysis()`` (HLO-level, no second XLA compile —
+        the cost is one host-side re-trace, attributed to the recompile
+        category); jax versions without it fall back to the AOT compile,
+        whose binary the XLA compilation cache dedupes."""
+        g = self.goodput
+        if g is None or not g.wants_flops:
+            return
+        if self._train_step is None:
+            g.flops_failed()   # offload tier: no single jitted step fn
+            return
+        try:
+            from deepspeed_tpu.profiling.flops_profiler import peak_tflops
+            with g.measure("recompile"):
+                lowered = self._train_step.lower(self.state, batches, lr)
+                try:
+                    cost = lowered.cost_analysis() or {}
+                except Exception:  # noqa: BLE001 — older jax: compile path
+                    cost = lowered.compile().cost_analysis() or {}
+            if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+                cost = cost[0] if cost else {}
+            flops = float(cost.get("flops", 0.0))
+            dev = jax.devices()[0]
+            g.set_flops(flops, n_chips=self.mesh.size,
+                        peak_tflops_per_chip=peak_tflops(
+                            getattr(dev, "device_kind", ""),
+                            dtype=self.precision.name))
+        except Exception as e:  # noqa: BLE001 — MFU is best-effort
+            g.flops_failed()
+            logger.warning("goodput: step cost analysis unavailable: %s", e)
 
     def _maybe_profile(self, fn, *args, params=None):
         """Emit the flops report at profile_step. lower+compile only
@@ -1557,6 +1658,9 @@ class TPUEngine:
 
     def _train_batch_inner(self, batches) -> jax.Array:
         tel = self.telemetry
+        g = self.goodput
+        if g is not None:
+            g.mark_gap()
         self.tput_timer.start()
         if self.wall_clock_breakdown:
             self.timers("dataloader").start()
@@ -1566,8 +1670,10 @@ class TPUEngine:
                 leading_gas_dim=True)
         if self.wall_clock_breakdown:
             self.timers("dataloader").stop()
-        tel.check_recompile("engine.train_step", batches,
-                            step=self.global_steps)
+        if g is not None:
+            g.mark("data_stall")
+        status = tel.check_recompile("engine.train_step", batches,
+                                     step=self.global_steps)
         fp = self.fault_plan
         if fp is not None and fp.should_hang(self.step_attempts):
             # In the armed watchdog window, before the step program: the
@@ -1582,6 +1688,7 @@ class TPUEngine:
                 self.lr_scheduler.step()
             self.tput_timer.stop()
             self._last_loss = loss
+            self._goodput_step_mark(status)
             # Feed the UNSCALED grad norm (norm_h is pre-unscale; coef is
             # the same factor get_global_grad_norm applies) so the offload
             # tier gets the same grad-norm anomaly coverage as the device
@@ -1611,6 +1718,8 @@ class TPUEngine:
             self.lr_scheduler.step()
         self.tput_timer.stop()
         self._last_loss = loss
+        self._goodput_step_mark(status)
+        self._maybe_goodput_cost_analysis(batches, lr)
         rolled_back = self._guardrails_step_hook(loss, overflow, norm)
         if self.config.check_numerics and not rolled_back:
             self._check_numerics(loss, overflow=bool(overflow))
@@ -1750,7 +1859,15 @@ class TPUEngine:
         gr = self.guardrails
         if gr is None or loss is None:
             return False
-        return gr.after_step(self, loss, overflow, norm)
+        step_before = self.global_steps
+        rolled = gr.after_step(self, loss, overflow, norm)
+        if rolled:
+            # Steps up to the pre-rollback high-water mark are re-executed
+            # ground: the goodput accountant books them as rollback_replay,
+            # not productive_step.
+            self._goodput_replay_until = max(self._goodput_replay_until,
+                                             step_before)
+        return rolled
 
     def save_checkpoint_async(self,
                               client_state: Optional[Dict] = None) -> None:
@@ -1773,6 +1890,9 @@ class TPUEngine:
         rcfg = self.config.resilience
         if not (rcfg.enabled and rcfg.auto_resume):
             return None, {}
+        if self.goodput is not None:
+            with self.goodput.measure("init_restore"):
+                return restore(self, rcfg.checkpoint.dir)
         return restore(self, rcfg.checkpoint.dir)
 
     def _snapshot_state(self) -> TrainState:

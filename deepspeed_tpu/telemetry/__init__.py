@@ -18,6 +18,10 @@ sites never branch on "is telemetry on".
 import os
 from typing import Optional
 
+from deepspeed_tpu.telemetry.goodput import (GOODPUT_METRIC_TAGS,
+                                             GoodputAccountant,
+                                             build_goodput)
+from deepspeed_tpu.telemetry.goodput import CATEGORIES as GOODPUT_CATEGORIES
 from deepspeed_tpu.telemetry.recompile import (RECOMPILE_COUNTER,
                                                RecompileDetector,
                                                tree_signature)
@@ -28,10 +32,11 @@ from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram,
 from deepspeed_tpu.telemetry.tracer import StepTracer
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "InMemorySink", "JSONLSink",
+    "Counter", "Gauge", "GOODPUT_CATEGORIES", "GOODPUT_METRIC_TAGS",
+    "GoodputAccountant", "Histogram", "InMemorySink", "JSONLSink",
     "MetricsRegistry", "RecompileDetector", "RECOMPILE_COUNTER", "Sink",
-    "StepTracer", "Telemetry", "TensorboardSink", "build_telemetry",
-    "tree_signature",
+    "StepTracer", "Telemetry", "TensorboardSink", "build_goodput",
+    "build_telemetry", "tree_signature",
 ]
 
 

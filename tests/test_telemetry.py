@@ -370,6 +370,9 @@ class TestEngineTelemetry:
         assert calls["n"] == 0
         assert engine.telemetry.enabled is False
         assert engine.telemetry.tracer.enabled is False
+        # goodput rides telemetry: off => None facade, zero added hooks
+        # (tests/test_goodput.py asserts the enabled path adds zero syncs)
+        assert engine.goodput is None
 
     def test_enabled_telemetry_does_sync(self, monkeypatch, tmp_path):
         engine = _engine({"telemetry": {"enabled": True,

@@ -1,0 +1,400 @@
+#!/usr/bin/env python
+"""Merge a run's per-attempt goodput manifests + metrics into ONE report.
+
+The run-level answer to "of N hours of wall-clock, what fraction trained,
+what was lost to which cause, and at what MFU?" — the artifact a fleet
+operator (and this repo's perf PRs) cite for unattended runs that
+restarted. Feed it the run dir (the job's ``telemetry.dir``, where the
+engine writes ``run_manifest.aNNNN.<host>.json`` and ``metrics.jsonl``;
+docs/OBSERVABILITY.md "Goodput accounting"):
+
+    python tools/goodput_report.py /runs/exp17/telemetry
+    python tools/goodput_report.py /runs/exp17/telemetry --json
+    python tools/goodput_report.py --selftest
+
+What the merge adds over any single attempt's numbers:
+
+- **inter-attempt downtime** — the gap between one attempt's death and the
+  next attempt's spawn (supervisor backoff + scheduling) becomes a
+  ``restart`` row instead of invisible time;
+- **cross-attempt replay** — steps the resumed attempt re-earned below the
+  previous attempt's high-water mark are reclassified from
+  productive_step to rollback_replay (the engine can't know; the merge
+  can, from first_step/steps_committed in adjacent manifests);
+- **unaccounted** — wall-clock the dead attempt never got to attribute
+  (death after its last manifest refresh), reported honestly as its own
+  row rather than silently inflating a category.
+
+Standalone on purpose: stdlib only, so it runs anywhere the run dir lands
+(including hosts without jax installed).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+MANIFEST_PREFIX = "run_manifest."
+DEFAULT_METRICS_FILE = "metrics.jsonl"
+
+# Keep in sync with deepspeed_tpu/telemetry/goodput.py CATEGORIES (this
+# tool is import-free by design; the doc-lint test pins the doc tables to
+# the package's list).
+CATEGORIES = (
+    "productive_step",
+    "ckpt_snapshot",
+    "ckpt_write_stall",
+    "rollback_restore",
+    "rollback_replay",
+    "data_stall",
+    "recompile",
+    "init_restore",
+    "idle_other",
+)
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+def load_manifests(run_dir: str) -> List[Dict[str, Any]]:
+    out = []
+    for name in sorted(os.listdir(run_dir)):
+        if not (name.startswith(MANIFEST_PREFIX) and name.endswith(".json")):
+            continue
+        path = os.path.join(run_dir, name)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"[goodput_report] skipping unreadable {name}: {e}",
+                  file=sys.stderr)
+            continue
+        doc["_file"] = name
+        out.append(doc)
+    return out
+
+
+def load_goodput_metrics(run_dir: str, metrics_file: str) -> Dict[Any, float]:
+    """Last value per (attempt, tag) for goodput/* and engine/mfu rows —
+    the gauges are cumulative, so last-write-wins is the freshest total."""
+    path = os.path.join(run_dir, metrics_file)
+    latest: Dict[Any, float] = {}
+    if not os.path.isfile(path):
+        return latest
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # torn final line of a killed attempt
+            tag = row.get("tag", "")
+            if not (tag.startswith("goodput/") or tag == "engine/mfu"):
+                continue
+            attempt = int(row.get("attempt", 0))
+            latest[(attempt, tag)] = float(row.get("value", 0.0))
+    return latest
+
+
+# ---------------------------------------------------------------------------
+# Merging
+# ---------------------------------------------------------------------------
+
+def _merge_attempt(manifests: List[Dict[str, Any]],
+                   metrics: Dict[Any, float]) -> Dict[str, Any]:
+    """Collapse one attempt's per-host manifests (averaging categories
+    across hosts — they describe the same wall-clock interval) and refresh
+    each category with the metrics stream when that is newer (both are
+    cumulative; max = freshest)."""
+    attempt = int(manifests[0].get("attempt", 0))
+    n = len(manifests)
+    cats = {c: 0.0 for c in CATEGORIES}
+    for m in manifests:
+        for c, v in (m.get("categories") or {}).items():
+            cats[c] = cats.get(c, 0.0) + float(v or 0.0)
+    cats = {c: v / n for c, v in cats.items()}
+    for c in CATEGORIES:
+        mv = metrics.get((attempt, f"goodput/{c}_sec"))
+        if mv is not None:
+            cats[c] = max(cats[c], mv)
+    starts = [m.get("start_wall") for m in manifests
+              if m.get("start_wall") is not None]
+    ends = [m.get("end_wall") for m in manifests
+            if m.get("end_wall") is not None]
+    start_wall = min(starts) if starts else None
+    end_wall = max(ends) if ends else None
+    wall = max((float(m.get("wall_sec") or 0.0) for m in manifests),
+               default=0.0)
+    wall = max(wall, metrics.get((attempt, "goodput/wall_sec"), 0.0))
+    if start_wall is not None and end_wall is not None:
+        wall = max(wall, end_wall - start_wall)
+    mfus = [m.get("mfu") for m in manifests if m.get("mfu") is not None]
+    mfu = metrics.get((attempt, "engine/mfu"),
+                      sum(mfus) / len(mfus) if mfus else None)
+    step_times = [m.get("mean_step_time_sec") for m in manifests
+                  if m.get("mean_step_time_sec") is not None]
+    first_steps = [m.get("first_step") for m in manifests
+                   if m.get("first_step") is not None]
+    rcs = [m.get("exit_rc") for m in manifests if m.get("exit_rc") is not None]
+    causes = [m.get("restart_cause") for m in manifests
+              if m.get("restart_cause")]
+    return {
+        "attempt": attempt,
+        "hosts": sorted({m.get("host", "?") for m in manifests}),
+        "run_id": manifests[0].get("run_id"),
+        "config_hash": manifests[0].get("config_hash"),
+        "start_wall": start_wall,
+        "end_wall": end_wall,
+        "wall_sec": wall,
+        "categories": cats,
+        "first_step": min(first_steps) if first_steps else None,
+        "steps_committed": max((int(m.get("steps_committed") or 0)
+                                for m in manifests), default=0),
+        "mean_step_time_sec": (sum(step_times) / len(step_times)
+                               if step_times else None),
+        "mfu": mfu,
+        "exit_rc": rcs[0] if rcs else None,
+        "restart_cause": causes[0] if causes else None,
+    }
+
+
+def merge_run(run_dir: str,
+              metrics_file: str = DEFAULT_METRICS_FILE) -> Dict[str, Any]:
+    """The cross-attempt merge. Returns the full report dict (the --json
+    output)."""
+    manifests = load_manifests(run_dir)
+    if not manifests:
+        raise FileNotFoundError(
+            f"no {MANIFEST_PREFIX}*.json manifests under {run_dir} — is "
+            "this the job's telemetry.dir, with telemetry.goodput on?")
+    metrics = load_goodput_metrics(run_dir, metrics_file)
+    by_attempt: Dict[int, List[Dict[str, Any]]] = {}
+    for m in manifests:
+        by_attempt.setdefault(int(m.get("attempt", 0)), []).append(m)
+    attempts = [_merge_attempt(by_attempt[a], metrics)
+                for a in sorted(by_attempt)]
+
+    # Cross-attempt replay: steps a resumed attempt re-earned at or below
+    # the previous attempt's high-water mark were booked productive by an
+    # engine that couldn't know better — reclassify their estimated time.
+    for prev, cur in zip(attempts, attempts[1:]):
+        if cur["first_step"] is None or cur["mean_step_time_sec"] is None:
+            continue
+        replay_steps = prev["steps_committed"] - (cur["first_step"] - 1)
+        if replay_steps <= 0:
+            continue
+        replay_sec = min(replay_steps * cur["mean_step_time_sec"],
+                         cur["categories"].get("productive_step", 0.0))
+        cur["categories"]["productive_step"] -= replay_sec
+        cur["categories"]["rollback_replay"] = \
+            cur["categories"].get("rollback_replay", 0.0) + replay_sec
+        cur["replay_steps"] = replay_steps
+
+    # Inter-attempt downtime: death -> next spawn (backoff + scheduling).
+    restart_sec = 0.0
+    for prev, cur in zip(attempts, attempts[1:]):
+        if prev["end_wall"] is not None and cur["start_wall"] is not None:
+            restart_sec += max(0.0, cur["start_wall"] - prev["end_wall"])
+
+    totals = {c: sum(a["categories"].get(c, 0.0) for a in attempts)
+              for c in CATEGORIES}
+    attempt_wall = sum(a["wall_sec"] for a in attempts)
+    starts = [a["start_wall"] for a in attempts
+              if a["start_wall"] is not None]
+    ends = [(a["end_wall"] if a["end_wall"] is not None
+             else (a["start_wall"] + a["wall_sec"]
+                   if a["start_wall"] is not None else None))
+            for a in attempts]
+    ends = [e for e in ends if e is not None]
+    if starts and ends:
+        run_wall = max(ends) - min(starts)
+    else:
+        run_wall = attempt_wall + restart_sec
+    # Wall-clock an attempt lived but never attributed (death after its
+    # last manifest refresh) — honesty row, never folded into a category.
+    unaccounted = max(0.0, run_wall - restart_sec
+                      - sum(totals.values()))
+    attributed = ((sum(totals.values()) + restart_sec) / run_wall
+                  if run_wall > 0 else 1.0)
+
+    productive = totals.get("productive_step", 0.0)
+    weights = [(a["categories"].get("productive_step", 0.0), a["mfu"])
+               for a in attempts if a["mfu"] is not None]
+    wsum = sum(w for w, _ in weights)
+    mfu = (sum(w * m for w, m in weights) / wsum if wsum > 0
+           else (weights[-1][1] if weights else None))
+
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "run_id": attempts[0].get("run_id"),
+        "config_hash": attempts[0].get("config_hash"),
+        "attempts": attempts,
+        "n_attempts": len(attempts),
+        "n_restarts": len(attempts) - 1,
+        "wall_sec": run_wall,
+        "categories": totals,
+        "restart_sec": restart_sec,
+        "unaccounted_sec": unaccounted,
+        "attributed_frac": attributed,
+        "goodput_frac": (productive / run_wall) if run_wall > 0 else 0.0,
+        "mfu": mfu,
+        "steps_committed": max((a["steps_committed"] for a in attempts),
+                               default=0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+def render(report: Dict[str, Any]) -> str:
+    out = []
+    wall = report["wall_sec"] or 1.0
+    mfu = report["mfu"]
+    out.append(f"goodput report — run {report.get('run_id')} "
+               f"({report['run_dir']})")
+    out.append(
+        f"attempts: {report['n_attempts']}   "
+        f"wall-clock: {report['wall_sec']:.1f} s   "
+        f"steps: {report['steps_committed']}   "
+        f"goodput: {report['goodput_frac']:.1%}   "
+        f"MFU: {mfu:.1%}   " if mfu is not None else
+        f"attempts: {report['n_attempts']}   "
+        f"wall-clock: {report['wall_sec']:.1f} s   "
+        f"steps: {report['steps_committed']}   "
+        f"goodput: {report['goodput_frac']:.1%}   MFU: n/a   ")
+    out[-1] += f"attributed: {report['attributed_frac']:.1%}"
+    out.append("")
+    hdr = f"{'category':<20} {'seconds':>12} {'share':>8}"
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    rows = sorted(report["categories"].items(), key=lambda kv: -kv[1])
+    rows.append(("restart", report["restart_sec"]))
+    rows.append(("unaccounted", report["unaccounted_sec"]))
+    for name, sec in rows:
+        out.append(f"{name:<20} {sec:>12.3f} {sec / wall:>7.1%}")
+    out.append("")
+    out.append("restarts:")
+    hdr = (f"  {'attempt':>7} {'rc':>5} {'cause':<11} {'steps':>6} "
+           f"{'wall s':>9} {'goodput':>8} {'mfu':>7}")
+    out.append(hdr)
+    out.append("  " + "-" * (len(hdr) - 2))
+    for a in report["attempts"]:
+        aw = a["wall_sec"] or 1.0
+        gp = a["categories"].get("productive_step", 0.0) / aw
+        m = f"{a['mfu']:.1%}" if a["mfu"] is not None else "n/a"
+        rc = a["exit_rc"] if a["exit_rc"] is not None else "?"
+        out.append(f"  {a['attempt']:>7} {rc!s:>5} "
+                   f"{(a['restart_cause'] or '?'):<11} "
+                   f"{a['steps_committed']:>6} {a['wall_sec']:>9.1f} "
+                   f"{gp:>7.1%} {m:>7}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Selftest
+# ---------------------------------------------------------------------------
+
+def _write(path: str, doc: Any) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def _selftest() -> int:
+    """Synthesize the 2-attempt run dir the e2e test produces for real
+    (SIGTERM mid-run, supervisor auto-resume), merge it, and assert the
+    invariants the report is trusted for: category totals sum to run
+    wall-clock within tolerance, goodput < 1 with nonzero restart /
+    init_restore / replay attribution, and MFU carried through."""
+    with tempfile.TemporaryDirectory() as td:
+        # Attempt 0: SIGTERM'd after step 30 — atexit never ran, so
+        # end_wall/exit_rc came from the supervisor stamp; its last
+        # manifest refresh attributed 60 of its 62 lived seconds.
+        _write(os.path.join(td, "run_manifest.a0000.hostA.json"), {
+            "format": 1, "run_id": "cafe01", "attempt": 0, "host": "hostA",
+            "config_hash": "deadbeef0123",
+            "start_wall": 1000.0, "end_wall": 1062.0, "wall_sec": 62.0,
+            "exit_rc": -15, "restart_cause": "preemption",
+            "categories": {"productive_step": 40.0, "data_stall": 4.0,
+                           "recompile": 8.0, "ckpt_snapshot": 2.0,
+                           "init_restore": 5.0, "idle_other": 1.0},
+            "first_step": 1, "steps_committed": 30,
+            "mean_step_time_sec": 1.0, "mfu": 0.30, "n_chips": 8})
+        # Attempt 1: spawned 2 s later (backoff), restored step 25,
+        # re-earned 26..30 (replay), ran clean to step 60.
+        _write(os.path.join(td, "run_manifest.a0001.hostA.json"), {
+            "format": 1, "run_id": "cafe01", "attempt": 1, "host": "hostA",
+            "config_hash": "deadbeef0123",
+            "start_wall": 1064.0, "end_wall": 1130.0, "wall_sec": 66.0,
+            "exit_rc": 0, "restart_cause": "clean",
+            "categories": {"productive_step": 44.0, "data_stall": 3.0,
+                           "recompile": 6.0, "ckpt_snapshot": 2.0,
+                           "init_restore": 10.0, "idle_other": 1.0},
+            "first_step": 26, "steps_committed": 60,
+            "mean_step_time_sec": 1.0, "mfu": 0.34, "n_chips": 8})
+        with open(os.path.join(td, DEFAULT_METRICS_FILE), "w") as f:
+            f.write(json.dumps({"tag": "engine/mfu", "value": 0.34,
+                                "step": 60, "kind": "gauge",
+                                "attempt": 1}) + "\n")
+            # torn final line from the SIGTERM — must be tolerated
+            f.write('{"tag": "goodput/wall_se')
+
+        report = merge_run(td)
+        text = render(report)
+
+    assert report["n_attempts"] == 2 and report["n_restarts"] == 1
+    # run wall = 1130 - 1000
+    assert abs(report["wall_sec"] - 130.0) < 1e-6
+    # restart gap = 1064 - 1062
+    assert abs(report["restart_sec"] - 2.0) < 1e-6, report["restart_sec"]
+    # replay: attempt 1 re-earned steps 26..30 at 1 s/step
+    a1 = report["attempts"][1]
+    assert a1.get("replay_steps") == 5
+    assert abs(report["categories"]["rollback_replay"] - 5.0) < 1e-6
+    assert abs(report["categories"]["productive_step"] - (40 + 44 - 5)) < 1e-6
+    # category totals (+restart +unaccounted) sum to run wall-clock
+    total = (sum(report["categories"].values()) + report["restart_sec"]
+             + report["unaccounted_sec"])
+    assert abs(total - report["wall_sec"]) / report["wall_sec"] < 0.05, total
+    assert report["attributed_frac"] > 0.95
+    assert 0.0 < report["goodput_frac"] < 1.0
+    assert report["categories"]["init_restore"] == 15.0
+    # MFU: productive-time-weighted over both attempts, in (0.30, 0.34)
+    assert 0.30 < report["mfu"] < 0.34, report["mfu"]
+    assert "restarts:" in text and "preemption" in text
+    print(text)
+    print("\nselftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", nargs="?",
+                    help="the job's telemetry.dir (run manifests + "
+                         "metrics.jsonl)")
+    ap.add_argument("--metrics", default=DEFAULT_METRICS_FILE,
+                    help="metrics JSONL filename inside the run dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged report as JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in 2-attempt round-trip check")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.run_dir:
+        ap.error("run dir required (or --selftest)")
+    report = merge_run(args.run_dir, metrics_file=args.metrics)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
