@@ -343,6 +343,11 @@ def main():
         "jax": jax.__version__,
         "telemetry": "off",
         "goodput": "off",
+        # Fleet telemetry (telemetry/fleet.py) would add a per-flush
+        # collective + host fetch; the timed windows run without it, and
+        # a future fleet-on BENCH round must record its fleet block here
+        # so rows stay attributable.
+        "fleet": "off",
         "peak_tflops_per_chip": peak,
         # Gradient-sync strategy the rows were measured under
         # (comm/grad_sync.py): none of the bench configs set a comm

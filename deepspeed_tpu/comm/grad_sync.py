@@ -159,6 +159,15 @@ class GradSyncPlan:
         self.data_size = int(mesh.shape.get(DATA_AXIS, 1))
         self.bits = int(comm_cfg.dcn_quant_bits)
         self.block = int(comm_cfg.quant_block_size)
+        # Nominal link bandwidths for the modeled device-time attribution
+        # (modeled_exposed_seconds / comm/exposed_frac). One source of
+        # truth with the config defaults (getattr covers hand-built cfg
+        # objects without the fields).
+        from deepspeed_tpu.config import constants as _C
+        self.ici_gbps = float(getattr(comm_cfg, "ici_gbps",
+                                      _C.COMM_ICI_GBPS_DEFAULT))
+        self.dcn_gbps = float(getattr(comm_cfg, "dcn_gbps",
+                                      _C.COMM_DCN_GBPS_DEFAULT))
         self.acc_dtype = acc_dtype
         self.ici_dtype = ici_dtype if ici_dtype is not None else acc_dtype
         # Micro-steps per optimizer step THIS plan's region runs: each one
@@ -485,6 +494,19 @@ class GradSyncPlan:
             "bucketed_elems": self.total_elems,
             "fallback_elems": self.fallback_elems,
         }
+
+    def modeled_exposed_seconds(self) -> float:
+        """Modeled EXPOSED collective seconds per optimizer step: this
+        plan's sync fires at the GAS boundary (nothing overlaps it —
+        ROADMAP item 1's premise), so every modeled wire byte is exposed
+        device time at the nominal link bandwidths. The numerator of
+        ``comm/exposed_frac`` and the ``goodput/exposed_comm_sec``
+        sub-attribution; replace with jax.profiler-measured collective
+        time via ``tools/fleet_report.py --profile-dir`` when a profile
+        was captured."""
+        m = self.modeled_bytes()
+        return (m["bytes_dcn"] / (self.dcn_gbps * 1e9)
+                + m["bytes_ici"] / (self.ici_gbps * 1e9))
 
     def describe(self) -> str:
         m = self.modeled_bytes()

@@ -412,12 +412,17 @@ class CommConfig:
     absmax scale granularity).
     ``bucket_mb``: flat gradient bucket size in MiB (the unit of the ICI
     reduce-scatter and DCN all-reduce).
+    ``ici_gbps`` / ``dcn_gbps``: nominal per-device link bandwidths behind
+    the modeled ``comm/exposed_frac`` device-time attribution
+    (docs/OBSERVABILITY.md "Fleet observability").
     """
 
     hierarchical: str = C.COMM_HIERARCHICAL_DEFAULT
     dcn_quant_bits: int = C.COMM_DCN_QUANT_BITS_DEFAULT
     quant_block_size: int = C.COMM_QUANT_BLOCK_SIZE_DEFAULT
     bucket_mb: float = C.COMM_BUCKET_MB_DEFAULT
+    ici_gbps: float = C.COMM_ICI_GBPS_DEFAULT
+    dcn_gbps: float = C.COMM_DCN_GBPS_DEFAULT
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CommConfig":
@@ -431,6 +436,10 @@ class CommConfig:
                                       C.COMM_QUANT_BLOCK_SIZE_DEFAULT)),
             bucket_mb=float(_get(d, C.COMM_BUCKET_MB,
                                  C.COMM_BUCKET_MB_DEFAULT)),
+            ici_gbps=float(_get(d, C.COMM_ICI_GBPS,
+                                C.COMM_ICI_GBPS_DEFAULT)),
+            dcn_gbps=float(_get(d, C.COMM_DCN_GBPS,
+                                C.COMM_DCN_GBPS_DEFAULT)),
         )
         if cfg.hierarchical not in ("auto", "on", "off"):
             raise ConfigError(
@@ -447,6 +456,10 @@ class CommConfig:
         if cfg.bucket_mb <= 0:
             raise ConfigError(
                 f"comm.bucket_mb must be positive, got {cfg.bucket_mb}")
+        if cfg.ici_gbps <= 0 or cfg.dcn_gbps <= 0:
+            raise ConfigError(
+                f"comm.ici_gbps/dcn_gbps must be positive, got "
+                f"{cfg.ici_gbps}/{cfg.dcn_gbps}")
         return cfg
 
 
@@ -516,6 +529,60 @@ class TelemetryMetricsConfig:
 
 
 @dataclass
+class TelemetryFleetConfig:
+    """Fleet observability knobs (telemetry/fleet.py): cross-host metric
+    aggregation at flush boundaries + rolling-window straggler detection.
+    Default off — enabled it adds one tiny jitted all-gather and one host
+    fetch per flush (never on the step path)."""
+
+    enabled: bool = C.TELEMETRY_FLEET_ENABLED_DEFAULT
+    window: int = C.TELEMETRY_FLEET_WINDOW_DEFAULT
+    min_window: int = C.TELEMETRY_FLEET_MIN_WINDOW_DEFAULT
+    zscore: float = C.TELEMETRY_FLEET_ZSCORE_DEFAULT
+    persist: int = C.TELEMETRY_FLEET_PERSIST_DEFAULT
+    breakdown_file: str = C.TELEMETRY_FLEET_BREAKDOWN_FILE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryFleetConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.TELEMETRY_FLEET_ENABLED,
+                              C.TELEMETRY_FLEET_ENABLED_DEFAULT)),
+            window=int(_get(d, C.TELEMETRY_FLEET_WINDOW,
+                            C.TELEMETRY_FLEET_WINDOW_DEFAULT)),
+            min_window=int(_get(d, C.TELEMETRY_FLEET_MIN_WINDOW,
+                                C.TELEMETRY_FLEET_MIN_WINDOW_DEFAULT)),
+            zscore=float(_get(d, C.TELEMETRY_FLEET_ZSCORE,
+                              C.TELEMETRY_FLEET_ZSCORE_DEFAULT)),
+            persist=int(_get(d, C.TELEMETRY_FLEET_PERSIST,
+                             C.TELEMETRY_FLEET_PERSIST_DEFAULT)),
+            breakdown_file=str(_get(d, C.TELEMETRY_FLEET_BREAKDOWN_FILE,
+                                    C.TELEMETRY_FLEET_BREAKDOWN_FILE_DEFAULT)),
+        )
+        if cfg.min_window < 1 or cfg.window < cfg.min_window:
+            raise ConfigError(
+                f"telemetry.fleet: need window >= min_window >= 1, got "
+                f"window={cfg.window} min_window={cfg.min_window}")
+        if cfg.zscore <= 0:
+            raise ConfigError(
+                f"telemetry.fleet.zscore must be positive, got {cfg.zscore}")
+        if cfg.persist < 1:
+            raise ConfigError(
+                f"telemetry.fleet.persist must be >= 1, got {cfg.persist}")
+        # The supervisor and the stdlib-only fleet_report discover the
+        # breakdown by the fleet_breakdown*.json pattern (they cannot see
+        # this config) — a name outside it would be written and then
+        # silently never read.
+        if not (cfg.breakdown_file.startswith("fleet_breakdown")
+                and cfg.breakdown_file.endswith(".json")):
+            raise ConfigError(
+                "telemetry.fleet.breakdown_file must match "
+                f"'fleet_breakdown*.json' (readers discover it by that "
+                f"pattern), got '{cfg.breakdown_file}'")
+        return cfg
+
+
+@dataclass
 class TelemetryConfig:
     """Unified observability (telemetry/; docs/OBSERVABILITY.md): metrics
     registry + Chrome-trace step tracer + recompilation detector. Disabled
@@ -532,6 +599,9 @@ class TelemetryConfig:
     # engine/mfu and per-attempt run manifests. Pure host clock reads —
     # no device syncs even when on — so it defaults on with telemetry.
     goodput: bool = C.TELEMETRY_GOODPUT_DEFAULT
+    # Fleet observability (telemetry/fleet.py): cross-host aggregation +
+    # straggler detection. Opt-in (adds a per-flush collective).
+    fleet: TelemetryFleetConfig = field(default_factory=TelemetryFleetConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
@@ -546,11 +616,16 @@ class TelemetryConfig:
                                           C.TELEMETRY_RECOMPILE_DEFAULT)),
             goodput=bool(_get(d, C.TELEMETRY_GOODPUT,
                               C.TELEMETRY_GOODPUT_DEFAULT)),
+            fleet=TelemetryFleetConfig.from_dict(d.get(C.TELEMETRY_FLEET)),
         )
         if cfg.enabled and not cfg.dir:
             raise ConfigError(
                 "telemetry.enabled requires telemetry.dir (where the trace "
                 "file and metrics JSONL land)")
+        if cfg.fleet.enabled and not cfg.goodput:
+            raise ConfigError(
+                "telemetry.fleet requires telemetry.goodput (fleet "
+                "aggregation reads the goodput accountant's deltas)")
         return cfg
 
 

@@ -177,6 +177,24 @@ TELEMETRY_RECOMPILE_DEFAULT = True
 # — pure host clock reads).
 TELEMETRY_GOODPUT = "goodput"
 TELEMETRY_GOODPUT_DEFAULT = True
+# Fleet observability (telemetry/fleet.py): cross-host metric aggregation
+# at flush boundaries (a tiny jitted all-gather OFF the step path) +
+# rolling-window straggler detection. Default OFF: enabled it adds one
+# collective + one host fetch per flush, which the zero-overhead contract
+# reserves for explicit opt-in.
+TELEMETRY_FLEET = "fleet"
+TELEMETRY_FLEET_ENABLED = "enabled"
+TELEMETRY_FLEET_ENABLED_DEFAULT = False
+TELEMETRY_FLEET_WINDOW = "window"
+TELEMETRY_FLEET_WINDOW_DEFAULT = 8            # flushes in the z-score window
+TELEMETRY_FLEET_MIN_WINDOW = "min_window"
+TELEMETRY_FLEET_MIN_WINDOW_DEFAULT = 3        # flushes before verdicts fire
+TELEMETRY_FLEET_ZSCORE = "zscore"
+TELEMETRY_FLEET_ZSCORE_DEFAULT = 3.0
+TELEMETRY_FLEET_PERSIST = "persist"
+TELEMETRY_FLEET_PERSIST_DEFAULT = 3           # verdicts until "persistent"
+TELEMETRY_FLEET_BREAKDOWN_FILE = "breakdown_file"
+TELEMETRY_FLEET_BREAKDOWN_FILE_DEFAULT = "fleet_breakdown.json"
 
 #############################################
 # Logging / misc
@@ -306,3 +324,12 @@ COMM_QUANT_BLOCK_SIZE = "quant_block_size"
 COMM_QUANT_BLOCK_SIZE_DEFAULT = 1024
 COMM_BUCKET_MB = "bucket_mb"
 COMM_BUCKET_MB_DEFAULT = 16.0
+# Nominal per-device link bandwidths behind the modeled device-time
+# attribution (comm/exposed_frac): exposed-collective seconds =
+# bytes_dcn / dcn + bytes_ici / ici. Defaults approximate a v4-class
+# slice (ICI ~90 GB/s per chip) and a 100 Gbit/s DCN NIC per host;
+# override per deployment for honest fractions.
+COMM_ICI_GBPS = "ici_gbps"
+COMM_ICI_GBPS_DEFAULT = 90.0
+COMM_DCN_GBPS = "dcn_gbps"
+COMM_DCN_GBPS_DEFAULT = 12.5

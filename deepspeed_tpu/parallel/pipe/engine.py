@@ -65,6 +65,10 @@ class PipelineEngine(TPUEngine):
                          param_partition_specs=base_specs, **kwargs)
         self.num_stages = self.mesh.shape.get(PIPE_AXIS, 1)
         self.micro_batches = self.gradient_accumulation_steps
+        # This engine feeds the fleet step-time from its OUTER pipe_step
+        # span (train_batch below); the base engine's inner train_step
+        # note must stay off or the two would average.
+        self._fleet_note_inner_span = False
         log_dist(f"PipelineEngine: stages={self.num_stages} "
                  f"micro_batches={self.micro_batches}", ranks=[0])
 
@@ -355,6 +359,17 @@ class PipelineEngine(TPUEngine):
         finally:
             if gr is not None:
                 gr.step_end()
+        if (self.fleet is not None and sp.duration
+                and tel.tracer.sync_spans):
+            # The OUTER pipe_step span brackets the whole pipelined step
+            # (schedule + bubbles included) with sync'd boundaries — the
+            # step time the fleet straggler detector should compare, since
+            # a slow stage host stretches exactly this span. The base
+            # engine's inner train_step note is disabled
+            # (_fleet_note_inner_span) so the two spans are never
+            # averaged; without sync_spans the span is dispatch-only and
+            # the goodput fallback is used instead.
+            self.fleet.note_step_time(sp.duration)
         if tel.enabled and self.num_stages > 1:
             # Per-stage bubble: in a GPipe/1F1B schedule every stage idles
             # (S-1) microbatch slots of the (M + S - 1)-slot step, so the

@@ -78,6 +78,11 @@ class Supervisor:
         self.restarts = 0
         self.immediate_restarts = 0
         self.exit_codes: List[int] = []
+        # Hosts the fleet layer marked as persistent stragglers (read from
+        # the run dir's fleet breakdown after each attempt) — surfaced in
+        # the logs today, and the input the elasticity policy (ROADMAP
+        # item 4) will use to pick which slice to drop on reshard.
+        self.straggler_hosts: List[str] = []
         self.metrics = None
         if ckpt_dir:
             from deepspeed_tpu.resilience.checkpoint import METRICS_FILE
@@ -114,6 +119,24 @@ class Supervisor:
         except Exception as e:  # noqa: BLE001
             logger.warning("supervisor: manifest finalize failed: %s", e)
 
+    def _note_stragglers(self) -> None:
+        """Surface persistent-straggler verdicts from the fleet breakdown
+        file alongside the restart decision. Best-effort."""
+        if not self.run_dir:
+            return
+        try:
+            from deepspeed_tpu.telemetry.fleet import \
+                read_persistent_stragglers
+            hosts = read_persistent_stragglers(self.run_dir)
+        except Exception:  # noqa: BLE001
+            return
+        if hosts:
+            self.straggler_hosts = hosts
+            logger.warning(
+                "supervisor: fleet telemetry marked persistent straggler "
+                "host(s) %s — throughput is paced by them; an elastic "
+                "restart excluding them may recover goodput", hosts)
+
     def run(self) -> int:
         """Run until clean exit or restart budget exhausted; returns the
         final exit code (0 on success)."""
@@ -134,6 +157,7 @@ class Supervisor:
                 raise
             self.exit_codes.append(rc)
             self._finalize_attempt(attempt, rc, start_wall)
+            self._note_stragglers()
             if rc == 0:
                 if self.metrics is not None:
                     self.metrics.add_scalar(

@@ -328,6 +328,18 @@ class TPUEngine:
         # Highest step a rollback rewound past: steps re-committed at or
         # below it are replay (real compute, no net progress).
         self._goodput_replay_until = 0
+        # Fleet observability (telemetry/fleet.py): cross-host metric
+        # aggregation + straggler detection at flush boundaries. Disabled
+        # (the default) => None, every hook is one attribute check — no
+        # collective, no host fetch, same contract as goodput.
+        from deepspeed_tpu.telemetry.fleet import build_fleet
+        self.fleet = build_fleet(config.telemetry, telemetry=self.telemetry,
+                                 goodput=self.goodput)
+        # Whether _train_batch_inner's train_step span feeds the fleet
+        # step-time estimate. The pipeline engine turns this off and
+        # feeds its OUTER pipe_step span instead — otherwise both spans
+        # would be averaged and under-report the schedule overhead.
+        self._fleet_note_inner_span = True
         self.moq = None
         if config.quantize_training.get("enabled", False):
             if self._offload_cfg.enabled and self._offload_cfg.device == "nvme":
@@ -383,11 +395,11 @@ class TPUEngine:
         # `is None` — the disabled step path is bit-for-bit the pre-
         # guardrails one: no host fetches, no syncs, no snapshots.
         from deepspeed_tpu.guardrails import build_guardrails
-        tcfg = config.telemetry
         self.guardrails = build_guardrails(
             config.guardrails, telemetry=self.telemetry,
-            metrics_path=(os.path.join(tcfg.dir, tcfg.metrics.file)
-                          if tcfg.enabled else None),
+            # The facade's JSONL sink path (host-scoped on multi-host
+            # runs), not a re-derived config join.
+            metrics_path=self.telemetry.metrics_path,
             goodput=self.goodput)
         # Monotonic count of dispatched optimizer-step attempts. Unlike
         # global_steps it never rewinds on rollback: data-borne fault
@@ -1492,6 +1504,7 @@ class TPUEngine:
             # modeled from the plan shape (no device sync; see
             # docs/OBSERVABILITY.md "Gradient-sync metrics").
             self.grad_sync_plan.emit_telemetry(tel, self.global_steps)
+            self._emit_comm_attribution(tel)
         if self.goodput is not None:
             self.goodput.emit(self.global_steps)
         if self.global_steps % self.steps_per_print == 0:
@@ -1500,6 +1513,29 @@ class TPUEngine:
                 # Crash-freshness: a SIGTERM'd attempt keeps a manifest no
                 # older than one flush cadence.
                 self.goodput.write_manifest()
+            if self.fleet is not None:
+                # Cross-host aggregation rides the SAME flush boundary —
+                # the one collective + host fetch stays off the step path.
+                self.fleet.flush(self.global_steps)
+
+    def _emit_comm_attribution(self, tel) -> None:
+        """Device-time comm attribution: ``comm/exposed_frac`` is the
+        modeled exposed-collective share of the last measured step (the
+        hierarchical sync fires at the GAS boundary, so nothing overlaps
+        its wire time — ROADMAP item 1's baseline), and the same seconds
+        feed the ``goodput/exposed_comm_sec`` sub-attribution. Modeled
+        from the plan shape + nominal link bandwidths (comm.ici_gbps /
+        comm.dcn_gbps) — no device sync, no host fetch."""
+        g = self.goodput
+        if g is None:
+            return
+        dt = g.last_step_time()
+        if not dt or dt <= 0:
+            return
+        exposed = min(self.grad_sync_plan.modeled_exposed_seconds(), dt)
+        tel.registry.gauge("comm/exposed_frac").set(
+            exposed / dt, step=self.global_steps)
+        g.note_aux("exposed_comm_sec", exposed)
 
     def _goodput_step_mark(self, status) -> None:
         """End-of-step attribution: recompile when the detector saw this
@@ -1680,7 +1716,7 @@ class TPUEngine:
             # deadlocked-collective shape a real hang takes.
             fp.hang()
         if self._train_step is None:  # offloaded optimizer tier
-            with tel.span("train_step", step=self.global_steps):
+            with tel.span("train_step", step=self.global_steps) as sp:
                 loss = self._offload_train_batch(batches)
             self.global_steps += 1
             self.micro_steps += self.gradient_accumulation_steps
@@ -1689,6 +1725,10 @@ class TPUEngine:
             self.tput_timer.stop()
             self._last_loss = loss
             self._goodput_step_mark(status)
+            if (self.fleet is not None and sp.duration
+                    and self._fleet_note_inner_span
+                    and tel.tracer.sync_spans):
+                self.fleet.note_step_time(sp.duration)
             # Feed the UNSCALED grad norm (norm_h is pre-unscale; coef is
             # the same factor get_global_grad_norm applies) so the offload
             # tier gets the same grad-norm anomaly coverage as the device
@@ -1709,7 +1749,7 @@ class TPUEngine:
         lr = self._current_lr()
         self._maybe_profile(self._train_step, self.state, batches, lr,
                             params=self.state.params)
-        with tel.span("train_step", step=self.global_steps):
+        with tel.span("train_step", step=self.global_steps) as sp:
             self.state, loss, overflow, norm = self._train_step(self.state,
                                                                 batches, lr)
         self.global_steps += 1
@@ -1719,6 +1759,15 @@ class TPUEngine:
         self.tput_timer.stop()
         self._last_loss = loss
         self._goodput_step_mark(status)
+        if (self.fleet is not None and sp.duration
+                and self._fleet_note_inner_span
+                and tel.tracer.sync_spans):
+            # Sync'd span duration ≈ measured device step time — the
+            # fleet aggregator prefers it over goodput's host-clock delta
+            # (the "sync'd sub-step spans" device-time fallback). Without
+            # sync_spans the span brackets only the async dispatch, so
+            # the goodput fallback is the honest estimate.
+            self.fleet.note_step_time(sp.duration)
         self._maybe_goodput_cost_analysis(batches, lr)
         rolled_back = self._guardrails_step_hook(loss, overflow, norm)
         if self.config.check_numerics and not rolled_back:

@@ -18,6 +18,10 @@ sites never branch on "is telemetry on".
 import os
 from typing import Optional
 
+from deepspeed_tpu.telemetry.fleet import (FLEET_METRIC_TAGS, FleetAggregator,
+                                           build_fleet, default_host,
+                                           host_scoped_path,
+                                           telemetry_host_component)
 from deepspeed_tpu.telemetry.goodput import (GOODPUT_METRIC_TAGS,
                                              GoodputAccountant,
                                              build_goodput)
@@ -32,11 +36,13 @@ from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram,
 from deepspeed_tpu.telemetry.tracer import StepTracer
 
 __all__ = [
-    "Counter", "Gauge", "GOODPUT_CATEGORIES", "GOODPUT_METRIC_TAGS",
-    "GoodputAccountant", "Histogram", "InMemorySink", "JSONLSink",
-    "MetricsRegistry", "RecompileDetector", "RECOMPILE_COUNTER", "Sink",
-    "StepTracer", "Telemetry", "TensorboardSink", "build_goodput",
-    "build_telemetry", "tree_signature",
+    "Counter", "FLEET_METRIC_TAGS", "FleetAggregator", "Gauge",
+    "GOODPUT_CATEGORIES", "GOODPUT_METRIC_TAGS", "GoodputAccountant",
+    "Histogram", "InMemorySink", "JSONLSink", "MetricsRegistry",
+    "RecompileDetector", "RECOMPILE_COUNTER", "Sink", "StepTracer",
+    "Telemetry", "TensorboardSink", "build_fleet", "build_goodput",
+    "build_telemetry", "default_host", "host_scoped_path",
+    "telemetry_host_component", "tree_signature",
 ]
 
 
@@ -50,6 +56,13 @@ class Telemetry:
         self.tracer = tracer
         self.recompile = recompile
         self.enabled = bool(enabled)
+        # Path of the JSONL metrics sink (None without one) — the
+        # authoritative answer now that multi-host runs host-scope the
+        # filename; consumers (guardrails crashdump tail) read it instead
+        # of re-deriving the path from the config.
+        self.metrics_path = next(
+            (s.path for s in registry.sinks if isinstance(s, JSONLSink)),
+            None)
 
     # passthroughs used on the hot path — kept one attribute deep
     def span(self, name: str, **args):
@@ -97,11 +110,17 @@ def build_telemetry(tcfg, monitor=None) -> Telemetry:
             tel.registry.add_sink(TensorboardSink(monitor))
         return tel
 
+    # Multi-host runs on shared storage must not clobber each other's
+    # outputs: the metrics JSONL and trace file gain a `.<host>.`
+    # component (same convention as the goodput run manifest) whenever the
+    # run spans processes; single-host filenames stay byte-stable
+    # (host_scoped_path(name, None) is the compat alias).
+    host_part = telemetry_host_component()
     registry = MetricsRegistry()
     for sink_name in tcfg.metrics.sinks:
         if sink_name == "jsonl":
-            registry.add_sink(JSONLSink(
-                os.path.join(tcfg.dir, tcfg.metrics.file)))
+            registry.add_sink(JSONLSink(os.path.join(
+                tcfg.dir, host_scoped_path(tcfg.metrics.file, host_part))))
         elif sink_name == "memory":
             registry.add_sink(InMemorySink())
         elif sink_name == "tensorboard":
@@ -115,10 +134,12 @@ def build_telemetry(tcfg, monitor=None) -> Telemetry:
         registry.add_sink(TensorboardSink(monitor))
 
     tracer = StepTracer(
-        path=(os.path.join(tcfg.dir, tcfg.trace.file)
+        path=(os.path.join(tcfg.dir,
+                           host_scoped_path(tcfg.trace.file, host_part))
               if tcfg.trace.enabled else None),
         sync_spans=tcfg.trace.sync_spans,
-        jax_profiler_dir=tcfg.trace.jax_profiler_dir)
+        jax_profiler_dir=tcfg.trace.jax_profiler_dir,
+        host=host_part or default_host())
     recompile = RecompileDetector(registry=registry, tracer=tracer,
                                   enabled=tcfg.recompile_detection)
     return Telemetry(registry, tracer, recompile, enabled=True)

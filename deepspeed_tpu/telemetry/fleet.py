@@ -1,0 +1,466 @@
+"""Fleet observability — cross-host aggregation + straggler detection.
+
+PRs 2 and 5 gave every *process* rich telemetry; a multi-host run was
+still N blind JSONL files. This module is the fleet-level layer
+(docs/OBSERVABILITY.md "Fleet observability"):
+
+- **cross-host metric aggregation** — at flush boundaries (off the step
+  path) every host contributes a small fixed vector of scalars
+  (:data:`FLEET_FIELDS`: mean step time, goodput category deltas, HBM
+  peak, modeled exposed-comm seconds) to one tiny jitted all-gather over
+  a dedicated one-axis device mesh (:func:`all_gather_rows` — one owner
+  device per process). Host 0 emits ``fleet/*`` min / median / max /
+  argmax-host gauges and rewrites the per-host breakdown file
+  (``fleet_breakdown.json``) atomically.
+- **straggler detection** — per-host step-time skew over a rolling
+  window of flushes: a host whose windowed mean sits ``zscore`` robust
+  (median/MAD) deviations above the fleet median (with a relative scale
+  floor so a uniform fleet never false-positives) is named in a
+  ``fleet/straggler`` trace instant,
+  counted in ``telemetry/stragglers``, and booked as a
+  ``goodput/straggler_sec`` time-lost sub-attribution (the fleet runs at
+  the slowest host's pace; the excess over the median is the loss).
+  Hosts flagged ``persist`` times are marked *persistent* in the
+  breakdown file — the signal the elasticity supervisor (ROADMAP item 4)
+  will act on; :func:`read_persistent_stragglers` is its reader.
+- **device-time attribution feed** — engines with sync'd spans push the
+  measured step-span duration through :meth:`FleetAggregator.
+  note_step_time`, overriding the goodput host-clock estimate (the
+  "sync'd sub-step spans" fallback for runs without a jax.profiler dir).
+
+Zero-overhead contract (the PR 2/3/5 gate): ``telemetry.fleet`` defaults
+off and ``build_fleet`` then returns ``None`` — the engine holds
+``fleet = None`` and every hook is one attribute check: no extra device
+syncs, no host fetches, no collective. Enabled, all device work happens
+at the flush cadence, never on the step path.
+
+jax is imported lazily (gather paths only) so the telemetry package stays
+importable on jax-less report hosts.
+"""
+
+import collections
+import os
+import socket
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.telemetry.goodput import (TELEMETRY_HOST_ENV,
+                                             _atomic_write_json)
+from deepspeed_tpu.utils.logging import logger
+
+# The fixed per-host scalar vector every flush gathers. Order is the wire
+# layout — append only.
+FLEET_FIELDS = (
+    "step_time_sec",      # mean committed-step wall time since last flush
+    "data_stall_sec",     # goodput data_stall delta since last flush
+    "hbm_peak_bytes",     # max peak over this host's local devices
+    "productive_sec",     # goodput productive_step delta since last flush
+    "exposed_comm_sec",   # modeled exposed-collective seconds (delta)
+)
+
+_FLEET_STATS = ("min", "median", "max", "argmax_host")
+
+STRAGGLER_COUNTER = "telemetry/stragglers"
+STRAGGLER_INSTANT = "fleet/straggler"
+BREAKDOWN_FORMAT = 1
+
+# Every metric tag this module can emit (gauges, the straggler counter and
+# the straggler trace-instant name) — pinned against docs/OBSERVABILITY.md
+# in BOTH directions by tests/test_doc_lint.py, like GOODPUT_METRIC_TAGS.
+FLEET_METRIC_TAGS = frozenset(
+    {f"fleet/{f}_{s}" for f in FLEET_FIELDS for s in _FLEET_STATS}
+    | {"fleet/hosts", STRAGGLER_INSTANT, STRAGGLER_COUNTER})
+
+# Axis name of the throwaway gather mesh (never collides with model axes).
+FLEET_GATHER_AXIS = "fleet_host"
+
+# Hostname bytes gathered once so host 0 can NAME the argmax/straggler
+# host instead of reporting an index.
+_HOST_NAME_BYTES = 64
+
+
+def default_host() -> str:
+    """One convention with the goodput run manifest."""
+    return (os.environ.get(TELEMETRY_HOST_ENV)
+            or socket.gethostname().replace(os.sep, "_"))
+
+
+def host_scoped_path(filename: str, host: Optional[str]) -> str:
+    """Insert a ``.<host>.`` component before the extension. ``host=None``
+    returns the name unchanged — the single-host compat alias, so
+    existing runs/readers keep their stable ``metrics.jsonl`` /
+    ``trace.json`` paths."""
+    if not host:
+        return filename
+    root, ext = os.path.splitext(filename)
+    return f"{root}.{host}{ext}" if ext else f"{filename}.{host}"
+
+
+def telemetry_host_component() -> Optional[str]:
+    """The ``.<host>.`` filename component for this process: ``None`` on
+    single-process runs (bare filenames — the compat alias), the host
+    name when the run spans processes (shared-storage outputs must not
+    clobber each other) or when ``DSTPU_TELEMETRY_HOST`` forces it."""
+    forced = os.environ.get(TELEMETRY_HOST_ENV)
+    if forced:
+        return forced
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return default_host()
+    except Exception:  # noqa: BLE001 — no backend: single-host semantics
+        pass
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The tiny jitted cross-host collective
+# ---------------------------------------------------------------------------
+
+def fleet_owner_devices() -> List[Any]:
+    """One owner device per process, in process order — the participants
+    of the fleet gather (every process computes the same list)."""
+    import jax
+
+    per_proc: Dict[int, Any] = {}
+    for d in sorted(jax.devices(), key=lambda d: (d.process_index, d.id)):
+        per_proc.setdefault(d.process_index, d)
+    return [per_proc[p] for p in sorted(per_proc)]
+
+
+# (mesh, in-sharding, jitted gather) per (owners, n_cols): the jit cache
+# lives on the wrapper, so rebuilding the lambda each flush would retrace
+# and recompile the collective every time.
+_GATHER_CACHE: Dict[Any, Any] = {}
+
+
+def _gather_fns(owners: tuple, n_cols: int):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    key = (owners, n_cols)
+    hit = _GATHER_CACHE.get(key)
+    if hit is None:
+        mesh = Mesh(np.array(owners, dtype=object), (FLEET_GATHER_AXIS,))
+        sharded = NamedSharding(mesh, P(FLEET_GATHER_AXIS))
+        gather = jax.jit(lambda x: x,
+                         out_shardings=NamedSharding(mesh, P()))
+        hit = _GATHER_CACHE[key] = (sharded, gather)
+    return hit
+
+
+def all_gather_rows(owners: Sequence[Any],
+                    local_rows: Dict[int, np.ndarray]) -> np.ndarray:
+    """All-gather one fixed-size fp32 row per participant through ONE
+    jitted collective on a dedicated 1-axis mesh over ``owners`` (one
+    device per participant). ``local_rows`` maps participant index ->
+    [n] vector for the participants whose owner device is addressable
+    from this process (all of them in single-process tests; exactly one
+    in a real multi-host run). Returns the [n_hosts, n] matrix. The
+    mesh + jitted identity (whose replicated out-sharding IS the
+    all-gather) are cached per (owners, n_cols), so the collective
+    compiles once and is reused at every flush."""
+    import jax
+
+    owners = tuple(owners)
+    n_hosts = len(owners)
+    rows = {int(i): np.asarray(v, np.float32).reshape(1, -1)
+            for i, v in local_rows.items()}
+    n_cols = next(iter(rows.values())).shape[1]
+    sharded, gather = _gather_fns(owners, n_cols)
+    shards = [jax.device_put(rows[i], owners[i]) for i in sorted(rows)]
+    arr = jax.make_array_from_single_device_arrays(
+        (n_hosts, n_cols), sharded, shards)
+    out = gather(arr)
+    return np.asarray(out.addressable_shards[0].data)
+
+
+def _encode_host(name: str) -> np.ndarray:
+    raw = name.encode("utf-8", errors="replace")[:_HOST_NAME_BYTES]
+    vec = np.zeros((_HOST_NAME_BYTES,), np.float32)
+    vec[:len(raw)] = np.frombuffer(raw, np.uint8)
+    return vec
+
+
+def _decode_host(row: np.ndarray) -> str:
+    raw = bytes(int(b) for b in row if 0 < b < 256)
+    return raw.decode("utf-8", errors="replace") or "unknown"
+
+
+# ---------------------------------------------------------------------------
+# Aggregator
+# ---------------------------------------------------------------------------
+
+class FleetAggregator:
+    """Cross-host aggregation + straggler detection for one engine.
+
+    ``flush(step)`` (called by the engine at the metrics-flush cadence,
+    off the step path) collects this host's :data:`FLEET_FIELDS` deltas
+    from the goodput accountant, all-gathers every host's vector, and —
+    on the leader (process 0) — emits the ``fleet/*`` gauges, runs the
+    straggler z-score, and rewrites the breakdown file. ``ingest`` is the
+    gather-independent second half, driven directly by tests with
+    synthetic matrices (the documented multi-host-without-multi-host
+    seam)."""
+
+    def __init__(self, fcfg, run_dir: Optional[str] = None,
+                 telemetry=None, goodput=None, host: Optional[str] = None,
+                 owners: Optional[Sequence[Any]] = None,
+                 process_index: Optional[int] = None,
+                 leader: Optional[bool] = None):
+        self.cfg = fcfg
+        self.run_dir = run_dir
+        self.telemetry = telemetry
+        self.goodput = goodput
+        self.host = host or default_host()
+        self._owners = list(owners) if owners is not None else None
+        self._process_index = process_index
+        self._leader = leader
+        self._host_names: Optional[List[str]] = None
+        self._window: collections.deque = collections.deque(
+            maxlen=int(fcfg.window))
+        self.straggler_counts: Dict[str, int] = {}
+        self.last_verdict: Optional[Dict[str, Any]] = None
+        self._prev: Optional[Dict[str, float]] = None
+        # sync'd-span step-time feed (sum, count) since the last flush —
+        # when present it overrides the goodput host-clock estimate.
+        self._span_sum = 0.0
+        self._span_count = 0
+
+    # -- topology (lazy: first flush, after the backend surely exists) ---
+    def _topology(self):
+        if self._owners is None:
+            self._owners = fleet_owner_devices()
+        if self._process_index is None:
+            import jax
+            self._process_index = jax.process_index()
+        if self._leader is None:
+            self._leader = self._process_index == 0
+        return self._owners, self._process_index
+
+    # -- local collection ------------------------------------------------
+    def note_step_time(self, seconds: float) -> None:
+        """Feed one sync'd step-span duration (the measured device step
+        time) — better than the goodput host-clock delta when available."""
+        if seconds and seconds > 0:
+            self._span_sum += float(seconds)
+            self._span_count += 1
+
+    def collect_local(self) -> Optional[np.ndarray]:
+        """This host's :data:`FLEET_FIELDS` vector for the interval since
+        the previous flush (None before any committed step). Pure host
+        reads — goodput totals, registry gauge values, span feeds."""
+        g = self.goodput
+        if g is None:
+            return None
+        totals = g.totals()
+        ssum, scount = g.step_time_stats()
+        aux = g.aux_totals()
+        cur = {
+            "step_sum": ssum, "step_count": float(scount),
+            "data_stall": totals.get("data_stall", 0.0),
+            "productive": totals.get("productive_step", 0.0),
+            "exposed": aux.get("exposed_comm_sec", 0.0),
+        }
+        prev = self._prev or {k: 0.0 for k in cur}
+        self._prev = cur
+        d_count = cur["step_count"] - prev["step_count"]
+        span_count = self._span_count
+        if d_count <= 0 and span_count == 0:
+            return None                       # nothing stepped since last
+        if span_count:
+            step_time = self._span_sum / span_count
+        else:
+            step_time = (cur["step_sum"] - prev["step_sum"]) / d_count
+        self._span_sum = 0.0
+        self._span_count = 0
+        # Committed-step count is authoritative (an engine may note more
+        # than one sync'd span per step — e.g. pipe_step + train_step).
+        self._steps_delta = d_count if d_count > 0 else 1.0
+        hbm = 0.0
+        tel = self.telemetry
+        if tel is not None:
+            v = tel.registry.gauge("engine/hbm_peak_bytes").value
+            hbm = float(v) if v else 0.0
+        return np.array([
+            step_time,
+            max(0.0, cur["data_stall"] - prev["data_stall"]),
+            hbm,
+            max(0.0, cur["productive"] - prev["productive"]),
+            max(0.0, cur["exposed"] - prev["exposed"]),
+        ], np.float32)
+
+    # -- the flush-boundary hook ----------------------------------------
+    def flush(self, step: int) -> Optional[Dict[str, Any]]:
+        vec = self.collect_local()
+        if vec is None:
+            return None
+        try:
+            owners, pidx = self._topology()
+            if self._host_names is None:
+                names = all_gather_rows(
+                    owners, self._addressable_rows(owners, pidx,
+                                                   _encode_host(self.host)))
+                self._host_names = [_decode_host(r) for r in names]
+            matrix = all_gather_rows(
+                owners, self._addressable_rows(owners, pidx, vec))
+        except Exception as e:  # noqa: BLE001 — observability must never
+            # take down the step loop it observes
+            logger.warning("fleet gather failed: %s", e)
+            return None
+        return self.ingest(step, matrix, hosts=self._host_names,
+                           steps_delta=getattr(self, "_steps_delta", 1.0))
+
+    def _addressable_rows(self, owners, pidx, vec) -> Dict[int, np.ndarray]:
+        """Single-process: every participant's shard is addressable and
+        must be supplied (they all carry this host's row — there IS only
+        one host). Multi-process: exactly this process's row."""
+        addressable = {i for i, d in enumerate(owners)
+                       if getattr(d, "process_index", 0) == pidx}
+        return {i: vec for i in (addressable or {pidx})}
+
+    # -- aggregation + straggler verdicts (gather-independent) -----------
+    def ingest(self, step: int, matrix: np.ndarray,
+               hosts: Optional[Sequence[str]] = None,
+               steps_delta: float = 1.0) -> Dict[str, Any]:
+        matrix = np.asarray(matrix, np.float64)
+        n_hosts = matrix.shape[0]
+        hosts = (list(hosts) if hosts
+                 else [f"host{i}" for i in range(n_hosts)])
+        # flush() resolves the topology before calling; a direct ingest
+        # (tests, report tooling) defaults to leader semantics.
+        leader = True if self._leader is None else bool(self._leader)
+        stats: Dict[str, Dict[str, Any]] = {}
+        for j, field in enumerate(FLEET_FIELDS):
+            col = matrix[:, j]
+            amax = int(np.argmax(col))
+            stats[field] = {"min": float(col.min()),
+                            "median": float(np.median(col)),
+                            "max": float(col.max()),
+                            "argmax_host": amax,
+                            "argmax_host_name": hosts[amax]}
+        verdict = self._detect_straggler(step, matrix[:, 0], hosts,
+                                         steps_delta)
+        if leader:
+            self._emit(step, n_hosts, stats, verdict)
+            self._write_breakdown(step, matrix, hosts, stats)
+        return {"step": step, "hosts": hosts, "stats": stats,
+                "straggler": verdict}
+
+    def _detect_straggler(self, step, step_times, hosts, steps_delta):
+        self._window.append(np.asarray(step_times, np.float64))
+        if (len(self._window) < int(self.cfg.min_window)
+                or len(hosts) < 2):
+            return None
+        means = np.mean(np.stack(list(self._window)), axis=0)
+        # Robust (median/MAD) z-score: a population std would include the
+        # outlier itself, capping max-z at ~sqrt(n_hosts-1) — a 2x
+        # straggler in a 4-host fleet would never cross 3. The relative
+        # scale floor (5% of the median step time) keeps a near-uniform
+        # fleet from flagging its marginally-slowest host (same idea as
+        # the guardrails detector's sigma floor).
+        med = float(np.median(means))
+        mad = float(np.median(np.abs(means - med))) * 1.4826
+        z = (means - med) / max(mad, 0.05 * max(med, 1e-12), 1e-12)
+        worst = int(np.argmax(z))
+        if z[worst] < float(self.cfg.zscore):
+            self.last_verdict = None
+            return None
+        host = hosts[worst]
+        self.straggler_counts[host] = self.straggler_counts.get(host, 0) + 1
+        verdict = {"host": host, "index": worst,
+                   "zscore": float(z[worst]),
+                   "count": self.straggler_counts[host],
+                   "persistent": (self.straggler_counts[host]
+                                  >= int(self.cfg.persist)),
+                   # The fleet steps at the slowest host's pace: excess
+                   # over the median, over the flushed steps, is the
+                   # fleet-level time lost to this straggler.
+                   "lost_sec": float(max(0.0, step_times[worst]
+                                         - np.median(step_times))
+                                     * max(steps_delta, 1.0))}
+        self.last_verdict = verdict
+        return verdict
+
+    def _emit(self, step, n_hosts, stats, verdict) -> None:
+        tel = self.telemetry
+        if tel is None or not getattr(tel, "enabled", False):
+            return
+        reg = tel.registry
+        for field, s in stats.items():
+            for stat in _FLEET_STATS:
+                reg.gauge(f"fleet/{field}_{stat}").set(float(s[stat]),
+                                                       step=step)
+        reg.gauge("fleet/hosts").set(n_hosts, step=step)
+        if verdict is not None:
+            tel.instant(STRAGGLER_INSTANT, host=verdict["host"],
+                        zscore=round(verdict["zscore"], 3), step=step,
+                        persistent=verdict["persistent"])
+            reg.counter(STRAGGLER_COUNTER).inc(step=step,
+                                               host=verdict["host"])
+            if self.goodput is not None and verdict["lost_sec"] > 0:
+                self.goodput.note_aux("straggler_sec", verdict["lost_sec"])
+
+    def _write_breakdown(self, step, matrix, hosts, stats) -> None:
+        if not self.run_dir:
+            return
+        doc = {
+            "format": BREAKDOWN_FORMAT,
+            "step": int(step),
+            "hosts": list(hosts),
+            "fields": {f: [float(v) for v in matrix[:, j]]
+                       for j, f in enumerate(FLEET_FIELDS)},
+            "stats": stats,
+            "stragglers": {
+                h: {"count": c,
+                    "persistent": c >= int(self.cfg.persist),
+                    "last_zscore": (self.last_verdict["zscore"]
+                                    if self.last_verdict is not None
+                                    and self.last_verdict["host"] == h
+                                    else None)}
+                for h, c in self.straggler_counts.items()},
+            "window": len(self._window),
+            "zscore_threshold": float(self.cfg.zscore),
+        }
+        try:
+            _atomic_write_json(
+                os.path.join(self.run_dir, self.cfg.breakdown_file), doc)
+        except OSError as e:
+            logger.warning("fleet breakdown write failed: %s", e)
+
+
+def build_fleet(tcfg, telemetry=None, goodput=None) -> \
+        Optional[FleetAggregator]:
+    """``None`` unless telemetry AND its fleet block are enabled — the
+    engine's hooks gate on ``is None`` (the zero-overhead contract, same
+    shape as goodput/guardrails). Fleet aggregation reads the goodput
+    accountant's deltas; ``TelemetryConfig.from_dict`` already rejects
+    ``fleet.enabled`` without goodput, and a hand-built config that
+    bypasses validation degrades safely (``collect_local`` returns None
+    when ``goodput`` is None, so ``flush`` no-ops)."""
+    if tcfg is None or not tcfg.enabled or not tcfg.fleet.enabled:
+        return None
+    return FleetAggregator(tcfg.fleet, run_dir=tcfg.dir,
+                           telemetry=telemetry, goodput=goodput)
+
+
+def read_persistent_stragglers(run_dir: str) -> List[str]:
+    """Hosts marked persistent in any fleet breakdown file under
+    ``run_dir`` — the supervisor's (and, later, the elasticity
+    policy's) reader. Best-effort: unreadable files are skipped."""
+    import glob as _glob
+    import json as _json
+
+    out = set()
+    for path in sorted(_glob.glob(os.path.join(run_dir,
+                                               "fleet_breakdown*.json"))):
+        try:
+            with open(path) as f:
+                doc = _json.load(f)
+        except (OSError, ValueError):
+            continue
+        for host, info in (doc.get("stragglers") or {}).items():
+            if info.get("persistent"):
+                out.add(host)
+    return sorted(out)

@@ -62,9 +62,13 @@ import os
 import socket
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 RUN_ID_ENV = "DSTPU_RUN_ID"
+# Overrides the hostname in every per-host telemetry artifact (run
+# manifests, host-scoped metrics/trace filenames, fleet rows) — ONE
+# convention across goodput and the fleet layer.
+TELEMETRY_HOST_ENV = "DSTPU_TELEMETRY_HOST"
 # Stamped by the supervisor/launcher at child spawn so the accountant can
 # attribute interpreter start-up (imports dwarf engine construction) to
 # init_restore instead of leaving it invisible.
@@ -93,7 +97,12 @@ _STEP_CATEGORIES = ("productive_step", "rollback_replay")
 GOODPUT_METRIC_TAGS = frozenset(
     {f"goodput/{c}_sec" for c in CATEGORIES}
     | {"goodput/wall_sec", "goodput/goodput_frac",
-       "goodput/steps_committed", "goodput/pipe_bubble_sec", "engine/mfu"})
+       "goodput/steps_committed", "goodput/pipe_bubble_sec",
+       # Sub-attributions riding INSIDE productive_step (aux gauges, not
+       # partition categories): modeled exposed-collective time of the
+       # hierarchical grad sync, and fleet-level time lost waiting on a
+       # straggler host (telemetry/fleet.py).
+       "goodput/exposed_comm_sec", "goodput/straggler_sec", "engine/mfu"})
 
 
 def config_hash(param_dict: Optional[Dict[str, Any]]) -> str:
@@ -181,7 +190,8 @@ class GoodputAccountant:
             from deepspeed_tpu.resilience.fault import RESUME_ATTEMPT_ENV
             attempt = int(env.get(RESUME_ATTEMPT_ENV, "0") or 0)
         self.attempt = int(attempt)
-        self.host = host or socket.gethostname().replace(os.sep, "_")
+        self.host = (host or env.get(TELEMETRY_HOST_ENV)
+                     or socket.gethostname().replace(os.sep, "_"))
         self.cfg_hash = cfg_hash
         self.pid = os.getpid()
         self._clock = clock
@@ -209,6 +219,7 @@ class GoodputAccountant:
         self._steps_committed = 0
         self._step_time_sum = 0.0
         self._step_count = 0
+        self._last_step_dt: Optional[float] = None
         # MFU inputs: set once per compiled step fn by the engine.
         self._flops_per_step: Optional[float] = None
         self._n_chips = 1
@@ -266,6 +277,7 @@ class GoodputAccountant:
             if category in _STEP_CATEGORIES:
                 self._step_time_sum += dt
                 self._step_count += 1
+                self._last_step_dt = dt
         return dt
 
     def note_aux(self, name: str, seconds: float) -> None:
@@ -299,6 +311,23 @@ class GoodputAccountant:
             if self._step_count == 0:
                 return None
             return self._step_time_sum / self._step_count
+
+    def last_step_time(self) -> Optional[float]:
+        """Duration of the most recent measured (productive/replay) step —
+        the denominator of the per-step ``comm/exposed_frac`` gauge."""
+        with self._lock:
+            return self._last_step_dt
+
+    def step_time_stats(self) -> Tuple[float, int]:
+        """(cumulative measured step seconds, count) — the fleet
+        aggregator differences these across flushes."""
+        with self._lock:
+            return self._step_time_sum, self._step_count
+
+    def aux_totals(self) -> Dict[str, float]:
+        """Copy of the auxiliary (non-partition) gauge totals."""
+        with self._lock:
+            return dict(self._aux)
 
     def mfu(self) -> Optional[float]:
         """Model FLOPs utilisation of the measured (productive+replay)
@@ -381,6 +410,7 @@ class GoodputAccountant:
             "restart_cause": restart_cause,
             "wall_sec": wall,
             "categories": t,
+            "aux": self.aux_totals(),
             "first_step": self._first_step,
             "steps_committed": self._steps_committed,
             "mean_step_time_sec": self.mean_step_time(),

@@ -94,20 +94,27 @@ class StepTracer:
     def __init__(self, path: Optional[str] = None, enabled: Optional[bool] = None,
                  sync_spans: bool = True,
                  jax_profiler_dir: Optional[str] = None,
-                 max_events: int = 200_000):
+                 max_events: int = 200_000,
+                 host: Optional[str] = None):
         self.path = path
         self.enabled = bool(path) if enabled is None else bool(enabled)
         # Sync barriers strictly require an enabled tracer — the zero-cost
         # contract of disabled telemetry.
         self.sync_spans = bool(sync_spans) and self.enabled
         self.jax_profiler_dir = jax_profiler_dir
+        self.host = host
         self._events = collections.deque(maxlen=int(max_events))
         self.dropped_events = 0
         self._dirty = False
         self._lock = threading.Lock()
         self._epoch = time.perf_counter()
+        # Wall-clock anchor of the ts=0 epoch, persisted in the trace
+        # metadata so tools/fleet_report.py can clock-align traces from
+        # different hosts onto one timeline.
+        self._epoch_wall = time.time()
         self._pid = os.getpid()
         self._profiler_active = False
+        self._atexit_registered = False
         if self.enabled:
             self._meta("process_name", {"name": "deepspeed_tpu"})
             if jax_profiler_dir:
@@ -187,6 +194,15 @@ class StepTracer:
             os.makedirs(self.jax_profiler_dir, exist_ok=True)
             jax.profiler.start_trace(self.jax_profiler_dir)
             self._profiler_active = True
+            # Guarantee stop_trace even when a crash skips close(): an
+            # exception between start and stop otherwise leaks the
+            # profiler session (and its capture buffer) for the rest of
+            # the process. stop is idempotent, so a clean close() +
+            # atexit double-fire is harmless.
+            if not self._atexit_registered:
+                import atexit
+                atexit.register(self.stop_jax_profiler)
+                self._atexit_registered = True
         except Exception as e:  # noqa: BLE001 — profiler is best-effort
             from deepspeed_tpu.utils.logging import logger
             logger.warning("jax.profiler passthrough unavailable: %s", e)
@@ -218,9 +234,13 @@ class StepTracer:
         parent = os.path.dirname(self.path)
         if parent:
             os.makedirs(parent, exist_ok=True)
-        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               # wall_epoch: wall-clock time of ts=0 — the cross-host
+               # clock-alignment anchor fleet_report merges on.
+               "metadata": {"wall_epoch": self._epoch_wall,
+                            "host": self.host}}
         if dropped:
-            doc["metadata"] = {"dropped_events": dropped}
+            doc["metadata"]["dropped_events"] = dropped
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)

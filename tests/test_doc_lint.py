@@ -16,6 +16,7 @@ for tier-1.
 import os
 import re
 
+from deepspeed_tpu.telemetry.fleet import FLEET_METRIC_TAGS
 from deepspeed_tpu.telemetry.goodput import GOODPUT_METRIC_TAGS
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -26,6 +27,7 @@ DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 _METRIC_CALL_RE = re.compile(
     r"\.(?:gauge|counter|histogram|_counter)\(\s*(f?)([\"'])([^\"']+)\2")
 _GOODPUT_TOKEN_RE = re.compile(r"goodput/[A-Za-z_]+")
+_FLEET_TOKEN_RE = re.compile(r"fleet/[A-Za-z_]+")
 
 
 def _iter_py_files():
@@ -96,6 +98,24 @@ class TestDocDrift:
             f"docs/OBSERVABILITY.md names goodput tags the code never "
             f"emits: {phantom}")
         assert "engine/mfu" in doc
+
+    def test_fleet_tags_documented_and_vice_versa(self):
+        """The fleet surface (telemetry/fleet.py) is pinned in BOTH
+        directions like goodput: every tag the aggregator can emit —
+        the fleet/* gauges, the straggler instant and counter — must be
+        in the doc, and every fleet/* token the doc names must be one
+        the code emits."""
+        doc = _doc_text()
+        undocumented = sorted(t for t in FLEET_METRIC_TAGS if t not in doc)
+        assert not undocumented, undocumented
+        doc_tokens = set(_FLEET_TOKEN_RE.findall(doc))
+        phantom = sorted(t for t in doc_tokens
+                         if t not in FLEET_METRIC_TAGS)
+        assert not phantom, (
+            f"docs/OBSERVABILITY.md names fleet tags the code never "
+            f"emits: {phantom}")
+        # the device-time attribution gauge rides the same enforcement
+        assert "comm/exposed_frac" in doc
 
     def test_goodput_report_categories_in_sync(self):
         """tools/goodput_report.py is stdlib-only by design (no package

@@ -1,0 +1,555 @@
+"""Fleet observability tests (telemetry/fleet.py; docs/OBSERVABILITY.md
+"Fleet observability"): the cross-host all-gather driven on a
+multi-device CPU mesh, straggler injection (an inflated host's step
+marks must yield a verdict NAMING that host in the instants stream, the
+breakdown file AND the merged fleet report), the zero-overhead disabled
+contract (no device syncs, no collective, no host fetch), device-time
+comm attribution (comm/exposed_frac on a 2-slice mesh), per-host file
+namespacing with the single-host compat alias, the StepTracer
+jax.profiler stop guarantee, multi-trace trace_report, and
+tools/fleet_report.py."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config.config import (ConfigError, DeepSpeedTPUConfig,
+                                         TelemetryFleetConfig)
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.telemetry import (InMemorySink, MetricsRegistry,
+                                     StepTracer, Telemetry)
+from deepspeed_tpu.telemetry.fleet import (FLEET_FIELDS, FleetAggregator,
+                                           _decode_host, _encode_host,
+                                           all_gather_rows,
+                                           host_scoped_path,
+                                           read_persistent_stragglers)
+from deepspeed_tpu.telemetry.goodput import GoodputAccountant
+from deepspeed_tpu.telemetry.recompile import RecompileDetector
+
+from simple_model import mlp_loss_fn, mlp_params, random_batches
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _engine(config_extra=None, world=8, mesh=None):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn, params=mlp_params(),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 1},
+                **(config_extra or {})},
+        mesh=mesh if mesh is not None else build_mesh(data=world))
+    return engine
+
+
+def _tel_cfg(tmp_path, fleet=None, goodput=True, sinks=("memory",),
+             trace=False):
+    tel = {"enabled": True, "dir": str(tmp_path),
+           "trace": {"enabled": trace},
+           "metrics": {"sinks": list(sinks)},
+           "goodput": goodput}
+    if fleet is not None:
+        tel["fleet"] = fleet
+    return {"telemetry": tel, "steps_per_print": 1}
+
+
+def _facade(tmp_path, trace=True):
+    reg = MetricsRegistry()
+    mem = reg.add_sink(InMemorySink())
+    tracer = StepTracer(path=(str(tmp_path / "trace.json") if trace
+                              else None))
+    tel = Telemetry(reg, tracer, RecompileDetector(enabled=False))
+    return tel, mem
+
+
+def _aggregator(tmp_path, min_window=2, persist=2, zscore=3.0, window=8):
+    fcfg = TelemetryFleetConfig(enabled=True, window=window,
+                                min_window=min_window, zscore=zscore,
+                                persist=persist)
+    tel, mem = _facade(tmp_path)
+    g = GoodputAccountant(registry=None)
+    agg = FleetAggregator(fcfg, run_dir=str(tmp_path), telemetry=tel,
+                          goodput=g, host="host0", leader=True)
+    return agg, tel, mem, g
+
+
+# ---------------------------------------------------------------------------
+# The jitted gather, driven on the multi-device CPU mesh
+# ---------------------------------------------------------------------------
+class TestGather:
+    def test_all_gather_rows_over_devices(self, eight_devices):
+        """The real collective path: 8 owner devices (one per simulated
+        host), distinct rows, one jitted all-gather, full matrix back."""
+        devs = jax.devices()[:8]
+        rows = {i: np.array([i, 10.0 * i, 100.0 + i], np.float32)
+                for i in range(8)}
+        out = all_gather_rows(devs, rows)
+        assert out.shape == (8, 3)
+        for i in range(8):
+            np.testing.assert_allclose(out[i], rows[i])
+
+    def test_host_name_gather_roundtrip(self, eight_devices):
+        devs = jax.devices()[:3]
+        names = ["worker-0", "tpu-host-17.cell", "z"]
+        rows = {i: _encode_host(n) for i, n in enumerate(names)}
+        out = all_gather_rows(devs, rows)
+        assert [_decode_host(r) for r in out] == names
+
+
+# ---------------------------------------------------------------------------
+# Aggregation + straggler verdicts (gather-independent ingest seam)
+# ---------------------------------------------------------------------------
+class TestAggregator:
+    HOSTS = ["hostA", "hostB", "hostC"]
+
+    def _matrix(self, step_times, stall=0.1, hbm=1000.0, prod=1.0,
+                exposed=0.05):
+        return np.array([[st, stall, hbm * (i + 1), prod, exposed]
+                         for i, st in enumerate(step_times)], np.float32)
+
+    def test_stats_and_argmax_emitted(self, tmp_path):
+        agg, tel, mem, _ = self._build(tmp_path)
+        agg.ingest(5, self._matrix([1.0, 2.0, 1.5]), hosts=self.HOSTS)
+        assert mem.values("fleet/step_time_sec_min")[-1] == 1.0
+        assert mem.values("fleet/step_time_sec_median")[-1] == 1.5
+        assert mem.values("fleet/step_time_sec_max")[-1] == 2.0
+        assert mem.values("fleet/step_time_sec_argmax_host")[-1] == 1
+        assert mem.values("fleet/hbm_peak_bytes_argmax_host")[-1] == 2
+        assert mem.values("fleet/hosts")[-1] == 3
+        # every field emits its four stats
+        for f in FLEET_FIELDS:
+            for s in ("min", "median", "max", "argmax_host"):
+                assert mem.values(f"fleet/{f}_{s}"), (f, s)
+
+    def _build(self, tmp_path, **kw):
+        return _aggregator(tmp_path, **kw)
+
+    def test_straggler_injection_names_the_host(self, tmp_path):
+        """The acceptance injection: hostC's step marks inflated 2x -> the
+        verdict names hostC in the instants stream, the counter, the
+        goodput sub-attribution and the breakdown file."""
+        agg, tel, mem, g = self._build(tmp_path, min_window=2, persist=2)
+        verdicts = []
+        for step in range(1, 5):
+            out = agg.ingest(step, self._matrix([1.0, 1.0, 2.0]),
+                             hosts=self.HOSTS, steps_delta=4)
+            verdicts.append(out["straggler"])
+        assert verdicts[0] is None                 # below min_window
+        assert verdicts[1] is not None
+        assert all(v["host"] == "hostC" for v in verdicts[1:])
+        assert verdicts[2]["persistent"]           # persist=2 reached
+        # instants stream names the host
+        instants = [e for e in tel.tracer.events
+                    if e.get("ph") == "i" and e["name"] == "fleet/straggler"]
+        assert instants and instants[-1]["args"]["host"] == "hostC"
+        # counter + time-lost sub-attribution
+        assert mem.values("telemetry/stragglers")[-1] == 3
+        # lost = (2.0 - median 1.0) * steps_delta 4 per flagged flush
+        assert g.aux_totals()["straggler_sec"] == pytest.approx(3 * 4.0)
+        # breakdown file carries the named verdict
+        doc = json.load(open(tmp_path / "fleet_breakdown.json"))
+        assert doc["hosts"] == self.HOSTS
+        assert doc["stragglers"]["hostC"]["persistent"]
+        assert doc["stats"]["step_time_sec"]["argmax_host_name"] == "hostC"
+        assert read_persistent_stragglers(str(tmp_path)) == ["hostC"]
+
+    def test_uniform_fleet_never_flags(self, tmp_path):
+        """Sigma floor: near-identical hosts must not produce verdicts
+        (sd ~ 0 would otherwise make any jitter a >3-sigma event)."""
+        agg, _, mem, _ = self._build(tmp_path, min_window=2)
+        rng = np.random.default_rng(0)
+        for step in range(1, 12):
+            times = 1.0 + rng.normal(0, 1e-3, 3)
+            out = agg.ingest(step, self._matrix(list(times)),
+                             hosts=self.HOSTS)
+            assert out["straggler"] is None
+        assert "telemetry/stragglers" not in mem.tags()
+
+    def test_merged_fleet_report_names_the_straggler(self, tmp_path):
+        """Acceptance second half: the same injected run dir, merged by
+        tools/fleet_report.py, yields the verdict on the right host."""
+        agg, _, _, _ = self._build(tmp_path, min_window=2, persist=2)
+        for step in range(1, 5):
+            agg.ingest(step, self._matrix([1.0, 1.0, 2.0]),
+                       hosts=self.HOSTS, steps_delta=4)
+        fr = _load_tool("fleet_report")
+        report = fr.merge_fleet(str(tmp_path))
+        by_host = {r["host"]: r for r in report["hosts"]}
+        assert by_host["hostC"]["straggler"]
+        assert by_host["hostC"]["straggler_persistent"]
+        assert not by_host["hostA"]["straggler"]
+        assert report["persistent_stragglers"] == ["hostC"]
+        text = fr.render(report)
+        assert "hostC" in text and "persistent" in text
+
+
+# ---------------------------------------------------------------------------
+# Engine integration — the acceptance multi-device run
+# ---------------------------------------------------------------------------
+class TestEngineFleet:
+    def test_fleet_gauges_and_breakdown_on_multi_device_run(
+            self, eight_devices, tmp_path):
+        engine = _engine(_tel_cfg(tmp_path,
+                                  fleet={"enabled": True, "min_window": 1}))
+        rng = np.random.default_rng(0)
+        batches = random_batches(rng, gas=1, batch_size=16)
+        for _ in range(4):
+            engine.train_batch(batches)
+        assert engine.fleet is not None
+        mem = engine.telemetry.registry.sinks[0]
+        fleet_tags = {t for t in mem.tags() if t.startswith("fleet/")}
+        # 5 fields x 4 stats + fleet/hosts
+        assert len(fleet_tags) == len(FLEET_FIELDS) * 4 + 1, fleet_tags
+        assert mem.values("fleet/hosts")[-1] == 1
+        assert mem.values("fleet/step_time_sec_max")[-1] > 0
+        doc = json.load(open(tmp_path / "fleet_breakdown.json"))
+        assert len(doc["hosts"]) == 1
+        assert set(doc["fields"]) == set(FLEET_FIELDS)
+        # single host: the straggler detector must stay silent
+        assert "telemetry/stragglers" not in mem.tags()
+
+    def test_disabled_fleet_is_none_and_runs_no_collective(
+            self, eight_devices, tmp_path, monkeypatch):
+        """Zero-overhead contract: fleet off (the default) => engine.fleet
+        is None, the gather is never invoked (it raises if touched), no
+        fleet/* tags, no breakdown file, and ZERO device syncs on the
+        step path (tracer off)."""
+        from deepspeed_tpu.telemetry import fleet as fleet_mod
+        monkeypatch.setattr(
+            fleet_mod, "all_gather_rows",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("fleet gather invoked while disabled")))
+        engine = _engine(_tel_cfg(tmp_path))
+        assert engine.fleet is None
+        rng = np.random.default_rng(0)
+        batches = random_batches(rng, gas=1, batch_size=16)
+        engine.train_batch(batches)          # compile outside the window
+        from deepspeed_tpu.utils import timer as timer_mod
+        calls = {"n": 0}
+        monkeypatch.setattr(timer_mod, "_device_synchronize",
+                            lambda: calls.__setitem__("n", calls["n"] + 1))
+        for _ in range(10):
+            engine.train_batch(batches)
+        assert calls["n"] == 0
+        mem = engine.telemetry.registry.sinks[0]
+        assert not {t for t in mem.tags() if t.startswith("fleet/")}
+        assert not os.path.exists(tmp_path / "fleet_breakdown.json")
+
+    def test_fleet_requires_goodput(self):
+        with pytest.raises(ConfigError, match="fleet requires"):
+            DeepSpeedTPUConfig({
+                "train_micro_batch_size_per_gpu": 1,
+                "telemetry": {
+                    "enabled": True, "dir": "/tmp/x", "goodput": False,
+                    "fleet": {"enabled": True}}}, world_size=1)
+
+    def test_fleet_config_validation(self):
+        with pytest.raises(ConfigError, match="window"):
+            TelemetryFleetConfig.from_dict({"window": 1, "min_window": 4})
+        with pytest.raises(ConfigError, match="zscore"):
+            TelemetryFleetConfig.from_dict({"zscore": 0})
+        # readers discover the breakdown by pattern — off-pattern names
+        # would be written but never read
+        with pytest.raises(ConfigError, match="fleet_breakdown"):
+            TelemetryFleetConfig.from_dict({"breakdown_file": "fb.json"})
+        cfg = TelemetryFleetConfig.from_dict(
+            {"breakdown_file": "fleet_breakdown.run7.json"})
+        assert cfg.breakdown_file == "fleet_breakdown.run7.json"
+
+    def test_unsynced_spans_fall_back_to_goodput_step_time(
+            self, eight_devices, tmp_path, monkeypatch):
+        """With sync_spans off the train_step span brackets only the
+        async dispatch — the fleet must NOT ingest it as step time (the
+        goodput host-clock delta is the honest estimate)."""
+        cfg = _tel_cfg(tmp_path, fleet={"enabled": True, "min_window": 1})
+        cfg["telemetry"]["trace"] = {"enabled": True, "sync_spans": False}
+        engine = _engine(cfg)
+        noted = []
+        monkeypatch.setattr(engine.fleet, "note_step_time",
+                            lambda s: noted.append(s))
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            engine.train_batch(random_batches(rng, gas=1, batch_size=16))
+        assert noted == []
+        mem = engine.telemetry.registry.sinks[0]
+        assert mem.values("fleet/step_time_sec_max")[-1] > 0  # fallback
+
+
+# ---------------------------------------------------------------------------
+# Device-time comm attribution (comm/exposed_frac) on a 2-slice mesh
+# ---------------------------------------------------------------------------
+class TestExposedComm:
+    def _dcn_engine(self, tmp_path, fleet=False):
+        cfg = _tel_cfg(tmp_path,
+                       fleet=({"enabled": True, "min_window": 1}
+                              if fleet else None))
+        cfg.update({
+            "gradient_accumulation_steps": 2,
+            "comm": {"hierarchical": "on", "dcn_quant_bits": 8},
+            "zero_optimization": {"stage": 2},
+        })
+        return _engine(cfg, mesh=build_mesh(slices=2))
+
+    def _batches(self, rng, gas=2, bs=16):
+        return random_batches(rng, gas=gas, batch_size=bs)
+
+    def test_exposed_frac_emitted_and_bounded(self, eight_devices,
+                                              tmp_path):
+        engine = self._dcn_engine(tmp_path)
+        rng = np.random.default_rng(0)
+        batches = self._batches(rng)
+        for _ in range(3):
+            engine.train_batch(batches)
+        mem = engine.telemetry.registry.sinks[0]
+        fracs = mem.values("comm/exposed_frac")
+        assert fracs, "comm/exposed_frac never emitted"
+        assert all(0.0 < f <= 1.0 for f in fracs)
+        aux = engine.goodput.aux_totals()
+        assert aux["exposed_comm_sec"] > 0
+        # modeled seconds come from the plan's bandwidth model
+        plan_sec = engine.grad_sync_plan.modeled_exposed_seconds()
+        assert plan_sec > 0
+        # manifest persists the sub-attribution for goodput_report
+        doc = json.load(open(engine.goodput.manifest_path()))
+        assert doc["aux"]["exposed_comm_sec"] == pytest.approx(
+            aux["exposed_comm_sec"])
+
+    def test_exposed_feeds_fleet_vector(self, eight_devices, tmp_path):
+        engine = self._dcn_engine(tmp_path, fleet=True)
+        rng = np.random.default_rng(0)
+        batches = self._batches(rng)
+        for _ in range(3):
+            engine.train_batch(batches)
+        mem = engine.telemetry.registry.sinks[0]
+        assert mem.values("fleet/exposed_comm_sec_max")[-1] > 0
+
+    def test_implicit_path_emits_no_exposed_frac(self, eight_devices,
+                                                 tmp_path):
+        engine = _engine(_tel_cfg(tmp_path))      # no comm block
+        rng = np.random.default_rng(0)
+        engine.train_batch(random_batches(rng, gas=1, batch_size=16))
+        mem = engine.telemetry.registry.sinks[0]
+        assert "comm/exposed_frac" not in mem.tags()
+
+
+# ---------------------------------------------------------------------------
+# Per-host file namespacing (satellite): compat alias + forced scoping
+# ---------------------------------------------------------------------------
+class TestHostScopedFiles:
+    def test_host_scoped_path_unit(self):
+        assert host_scoped_path("metrics.jsonl", None) == "metrics.jsonl"
+        assert host_scoped_path("metrics.jsonl", "w3") == "metrics.w3.jsonl"
+        assert host_scoped_path("trace.json", "a.b") == "trace.a.b.json"
+        assert host_scoped_path("noext", "h") == "noext.h"
+
+    def test_single_host_filenames_stable(self, eight_devices, tmp_path):
+        engine = _engine(_tel_cfg(tmp_path, sinks=("jsonl",)))
+        rng = np.random.default_rng(0)
+        engine.train_batch(random_batches(rng, gas=1, batch_size=16))
+        engine.telemetry.flush()
+        assert os.path.exists(tmp_path / "metrics.jsonl")
+        assert engine.telemetry.metrics_path == str(
+            tmp_path / "metrics.jsonl")
+
+    def test_forced_host_scoping(self, eight_devices, tmp_path,
+                                 monkeypatch):
+        monkeypatch.setenv("DSTPU_TELEMETRY_HOST", "workerX")
+        cfg = _tel_cfg(tmp_path, sinks=("jsonl",), trace=True)
+        engine = _engine(cfg)
+        rng = np.random.default_rng(0)
+        engine.train_batch(random_batches(rng, gas=1, batch_size=16))
+        engine.telemetry.flush()
+        assert os.path.exists(tmp_path / "metrics.workerX.jsonl")
+        assert os.path.exists(tmp_path / "trace.workerX.json")
+        assert not os.path.exists(tmp_path / "metrics.jsonl")
+        assert not os.path.exists(tmp_path / "trace.json")
+        # the facade reports the real (scoped) metrics path
+        assert engine.telemetry.metrics_path.endswith(
+            "metrics.workerX.jsonl")
+        # the trace stamps its host + wall anchor for fleet_report
+        doc = json.load(open(tmp_path / "trace.workerX.json"))
+        assert doc["metadata"]["host"] == "workerX"
+        assert doc["metadata"]["wall_epoch"] > 0
+
+
+# ---------------------------------------------------------------------------
+# StepTracer jax.profiler stop guarantee (satellite)
+# ---------------------------------------------------------------------------
+class TestProfilerLifecycle:
+    def test_stop_trace_guaranteed_on_crash(self, tmp_path, monkeypatch):
+        """An exception between start and stop must not leak the profiler
+        session: the atexit hook registered at start stops it, and a
+        later close() must not double-stop."""
+        counts = {"start": 0, "stop": 0}
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda d: counts.__setitem__(
+                                "start", counts["start"] + 1))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: counts.__setitem__(
+                                "stop", counts["stop"] + 1))
+        import atexit
+        registered = []
+        monkeypatch.setattr(atexit, "register",
+                            lambda fn, *a, **k: registered.append(fn))
+        tracer = StepTracer(path=str(tmp_path / "t.json"),
+                            jax_profiler_dir=str(tmp_path / "prof"))
+        assert counts["start"] == 1 and tracer._profiler_active
+        assert tracer.stop_jax_profiler in registered
+        # simulated crash: close() never runs; the atexit hook fires
+        registered[0]()
+        assert counts["stop"] == 1
+        assert not tracer._profiler_active
+        tracer.close()                      # idempotent
+        assert counts["stop"] == 1
+
+    def test_clean_close_stops_once(self, tmp_path, monkeypatch):
+        counts = {"start": 0, "stop": 0}
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda d: counts.__setitem__(
+                                "start", counts["start"] + 1))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: counts.__setitem__(
+                                "stop", counts["stop"] + 1))
+        tracer = StepTracer(path=str(tmp_path / "t.json"),
+                            jax_profiler_dir=str(tmp_path / "prof"))
+        tracer.close()
+        assert counts["stop"] == 1
+        tracer.stop_jax_profiler()          # the atexit double-fire
+        assert counts["stop"] == 1
+
+
+# ---------------------------------------------------------------------------
+# trace_report multi-file (satellite)
+# ---------------------------------------------------------------------------
+class TestTraceReportMultiFile:
+    def _write_trace(self, path, host, with_meta=True):
+        doc = {"traceEvents": [
+            {"name": "train_step", "ph": "X", "pid": 1, "tid": 1,
+             "ts": 0.0, "dur": 1000.0},
+            {"name": "recompile", "ph": "i", "s": "t", "pid": 1,
+             "tid": 1, "ts": 0.0}]}
+        if with_meta:
+            doc["metadata"] = {"host": host, "wall_epoch": 1000.0}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+
+    def test_multi_file_rows_are_host_prefixed(self, tmp_path):
+        tr = _load_tool("trace_report")
+        self._write_trace(tmp_path / "trace.hostA.json", "hostA")
+        # no metadata: the filename component is the fallback label
+        self._write_trace(tmp_path / "trace.hostB.json", "hostB",
+                          with_meta=False)
+        paths = tr.expand_paths([str(tmp_path / "trace.*.json")])
+        assert len(paths) == 2
+        summary = tr.summarize(tr.load_many(paths))
+        names = {r["name"] for r in summary["spans"]}
+        assert names == {"hostA:train_step", "hostB:train_step"}
+        assert summary["instants"] == {"hostA:recompile": 1,
+                                       "hostB:recompile": 1}
+        text = tr.render(summary)
+        assert "hostA:train_step" in text
+
+    def test_single_file_unprefixed(self, tmp_path):
+        tr = _load_tool("trace_report")
+        self._write_trace(tmp_path / "trace.json", "solo")
+        summary = tr.summarize(tr.load_events(str(tmp_path / "trace.json")))
+        assert {r["name"] for r in summary["spans"]} == {"train_step"}
+
+    def test_selftest_cli(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "trace_report.py"), "--selftest"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "selftest ok" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# tools/fleet_report.py
+# ---------------------------------------------------------------------------
+class TestFleetReport:
+    def test_selftest_cli(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "fleet_report.py"), "--selftest"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "selftest ok" in proc.stdout
+
+    def test_merges_engine_written_run_dir(self, eight_devices, tmp_path):
+        """A real single-host engine run (fleet on, jsonl + trace) parses
+        into a 1-host report and a mergeable timeline."""
+        engine = _engine(_tel_cfg(tmp_path, sinks=("jsonl",), trace=True,
+                                  fleet={"enabled": True,
+                                         "min_window": 1}))
+        rng = np.random.default_rng(0)
+        batches = random_batches(rng, gas=1, batch_size=16)
+        for _ in range(3):
+            engine.train_batch(batches)
+        engine.telemetry.flush()
+        engine.goodput.write_manifest()
+        fr = _load_tool("fleet_report")
+        report = fr.merge_fleet(str(tmp_path))
+        assert report["n_hosts"] == 1
+        row = report["hosts"][0]
+        assert row["steps_committed"] >= 3
+        assert row["goodput_frac"] is not None and row["goodput_frac"] > 0
+        assert not row["straggler"]
+        timeline = fr.merge_timeline(
+            {h: p for h, p in report["trace_files"].items()})
+        assert any(e.get("ph") == "X" for e in timeline["traceEvents"])
+        fr.render(report)                    # renders without error
+
+    def test_timeline_tolerates_anchorless_trace(self, tmp_path):
+        """A legacy trace without a wall_epoch anchor must stay
+        base-aligned, not drag the base to unix epoch 0 (which would
+        shift every anchored host by ~1.7e9 s)."""
+        fr = _load_tool("fleet_report")
+        with open(tmp_path / "trace.hostA.json", "w") as f:
+            json.dump({"traceEvents": [
+                {"name": "train_step", "ph": "X", "pid": 1, "tid": 1,
+                 "ts": 7.0, "dur": 5.0}],
+                "metadata": {"wall_epoch": 1.7e9, "host": "hostA"}}, f)
+        with open(tmp_path / "trace.old.json", "w") as f:
+            json.dump([{"name": "train_step", "ph": "X", "pid": 1,
+                        "tid": 1, "ts": 3.0, "dur": 5.0}], f)
+        tl = fr.merge_timeline({"hostA": str(tmp_path / "trace.hostA.json"),
+                                "old": str(tmp_path / "trace.old.json")})
+        spans = {e["pid"]: e for e in tl["traceEvents"]
+                 if e.get("ph") == "X"}
+        # anchored host keeps its own ts (it IS the base); anchorless one
+        # is unshifted
+        assert sorted(e["ts"] for e in spans.values()) == [3.0, 7.0]
+        assert tl["metadata"]["aligned_to_wall_epoch"] == 1.7e9
+
+
+# ---------------------------------------------------------------------------
+# Supervisor surfaces persistent stragglers
+# ---------------------------------------------------------------------------
+class TestSupervisorStragglers:
+    def test_supervisor_reads_breakdown(self, tmp_path):
+        from deepspeed_tpu.resilience.supervisor import Supervisor
+        with open(tmp_path / "fleet_breakdown.json", "w") as f:
+            json.dump({"format": 1, "hosts": ["a", "b"],
+                       "stragglers": {"b": {"count": 4,
+                                            "persistent": True}}}, f)
+        sup = Supervisor([sys.executable, "-c", "pass"], max_restarts=0,
+                         run_dir=str(tmp_path))
+        assert sup.run() == 0
+        assert sup.straggler_hosts == ["b"]

@@ -1,0 +1,477 @@
+#!/usr/bin/env python
+"""Merge a multi-host run's telemetry into ONE fleet report + timeline.
+
+The fleet-level artifact (docs/OBSERVABILITY.md "Fleet observability"):
+feed it the job's ``telemetry.dir`` — where each host wrote its
+``metrics.<host>.jsonl`` / ``trace.<host>.json`` (single-host runs keep
+the bare names), the goodput ``run_manifest.aNNNN.<host>.json`` files,
+and host 0's ``fleet_breakdown.json`` — and get:
+
+- a **fleet summary table**: per-host goodput %, MFU, steps, mean step
+  time, exposed-comm fraction, and the straggler verdict (count +
+  persistent flag from the fleet detector's rolling z-score);
+- a **clock-aligned merged Perfetto timeline** (``--timeline OUT.json``):
+  every host's Chrome-trace spans on one time axis, aligned via the
+  ``wall_epoch`` anchor each tracer stamps in its metadata, one process
+  row per host;
+- optionally (``--profile-dir``) **measured collective time** parsed out
+  of ``jax.profiler`` perfetto captures (``*.trace.json.gz``) — the
+  ground-truth check on the modeled ``comm/exposed_frac``.
+
+Standalone on purpose: stdlib only (json, gzip, glob), so it runs
+anywhere the run dir lands — including hosts without jax.
+
+Usage:
+    python tools/fleet_report.py RUN_DIR [--json] [--timeline OUT.json]
+                                 [--profile-dir DIR]
+    python tools/fleet_report.py --selftest
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+MANIFEST_PREFIX = "run_manifest."
+BREAKDOWN_GLOB = "fleet_breakdown*.json"
+# XLA collective op names inside a jax.profiler capture.
+COLLECTIVE_RE = re.compile(
+    r"all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute",
+    re.IGNORECASE)
+
+# Metric tags the merge consumes (last value per (host, tag) wins — the
+# gauges are cumulative).
+_TAGS_OF_INTEREST = ("comm/exposed_frac", "engine/mfu",
+                     "goodput/goodput_frac", "goodput/productive_step_sec",
+                     "goodput/wall_sec", "goodput/steps_committed",
+                     "goodput/exposed_comm_sec", "goodput/straggler_sec")
+
+
+# ---------------------------------------------------------------------------
+# Discovery / loading
+# ---------------------------------------------------------------------------
+
+def _host_from_filename(name: str, stem: str, ext: str) -> Optional[str]:
+    """'metrics.hostA.jsonl' -> 'hostA'; bare 'metrics.jsonl' -> None."""
+    if not (name.startswith(stem + ".") and name.endswith(ext)):
+        return None
+    middle = name[len(stem) + 1:-len(ext)]
+    return middle.rstrip(".") or None
+
+
+def discover(run_dir: str) -> Dict[str, Any]:
+    names = sorted(os.listdir(run_dir))
+    metrics, traces = {}, {}
+    for n in names:
+        if n == "metrics.jsonl":
+            metrics[None] = os.path.join(run_dir, n)
+        else:
+            h = _host_from_filename(n, "metrics", ".jsonl")
+            if h:
+                metrics[h] = os.path.join(run_dir, n)
+        if n == "trace.json":
+            traces[None] = os.path.join(run_dir, n)
+        else:
+            h = _host_from_filename(n, "trace", ".json")
+            if h and not n.endswith(".tmp"):
+                traces[h] = os.path.join(run_dir, n)
+    manifests = []
+    for n in names:
+        if n.startswith(MANIFEST_PREFIX) and n.endswith(".json"):
+            try:
+                with open(os.path.join(run_dir, n)) as f:
+                    manifests.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+    breakdown = None
+    for p in sorted(glob.glob(os.path.join(run_dir, BREAKDOWN_GLOB))):
+        try:
+            with open(p) as f:
+                breakdown = json.load(f)
+        except (OSError, ValueError):
+            continue
+    return {"metrics": metrics, "traces": traces, "manifests": manifests,
+            "breakdown": breakdown}
+
+
+def load_metrics_last(path: str) -> Dict[str, float]:
+    """Last value per interesting tag in one metrics JSONL (torn final
+    lines of killed attempts tolerated)."""
+    out: Dict[str, float] = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                tag = row.get("tag", "")
+                if tag in _TAGS_OF_INTEREST or tag.startswith("fleet/"):
+                    out[tag] = float(row.get("value", 0.0))
+    except OSError:
+        pass
+    return out
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):           # bare-array Chrome trace variant
+        doc = {"traceEvents": doc, "metadata": {}}
+    doc.setdefault("metadata", {})
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Merge
+# ---------------------------------------------------------------------------
+
+def merge_fleet(run_dir: str) -> Dict[str, Any]:
+    found = discover(run_dir)
+    manifests = found["manifests"]
+    breakdown = found["breakdown"]
+    metrics = {h: load_metrics_last(p) for h, p in found["metrics"].items()}
+    hosts: List[str] = []
+
+    def _add(h):
+        if h and h not in hosts:
+            hosts.append(h)
+
+    for m in manifests:
+        _add(m.get("host"))
+    for h in found["metrics"]:
+        _add(h)
+    if breakdown:
+        for h in breakdown.get("hosts", []):
+            _add(h)
+    if not hosts:
+        hosts = ["local"]
+
+    # The bare metrics.jsonl / trace.json belong to the run's only host
+    # when there is exactly one (the single-host compat alias).
+    def _metrics_for(host):
+        if host in metrics:
+            return metrics[host]
+        if None in metrics and len(hosts) == 1:
+            return metrics[None]
+        return {}
+
+    straggler_info = (breakdown or {}).get("stragglers", {})
+    bd_hosts = (breakdown or {}).get("hosts", [])
+    bd_fields = (breakdown or {}).get("fields", {})
+
+    rows = []
+    for host in hosts:
+        mrows = [m for m in manifests if m.get("host") == host]
+        mt = _metrics_for(host)
+        wall = sum(float(m.get("wall_sec") or 0.0) for m in mrows)
+        productive = sum(
+            float((m.get("categories") or {}).get("productive_step", 0.0))
+            for m in mrows)
+        if wall <= 0:
+            wall = mt.get("goodput/wall_sec", 0.0)
+            productive = mt.get("goodput/productive_step_sec", productive)
+        weights = [(float((m.get("categories") or {})
+                          .get("productive_step", 0.0)), m.get("mfu"))
+                   for m in mrows if m.get("mfu") is not None]
+        wsum = sum(w for w, _ in weights)
+        mfu = (sum(w * f for w, f in weights) / wsum if wsum > 0
+               else (weights[-1][1] if weights
+                     else mt.get("engine/mfu")))
+        steps = max((int(m.get("steps_committed") or 0) for m in mrows),
+                    default=int(mt.get("goodput/steps_committed", 0)))
+        step_time = None
+        if host in bd_hosts and "step_time_sec" in bd_fields:
+            step_time = bd_fields["step_time_sec"][bd_hosts.index(host)]
+        elif mrows:
+            sts = [m.get("mean_step_time_sec") for m in mrows
+                   if m.get("mean_step_time_sec") is not None]
+            step_time = sum(sts) / len(sts) if sts else None
+        s = straggler_info.get(host) or {}
+        rows.append({
+            "host": host,
+            "steps_committed": steps,
+            "wall_sec": wall,
+            "goodput_frac": (productive / wall) if wall > 0
+            else mt.get("goodput/goodput_frac"),
+            "mfu": mfu,
+            "mean_step_time_sec": step_time,
+            "exposed_frac": mt.get("comm/exposed_frac"),
+            "exposed_comm_sec": mt.get("goodput/exposed_comm_sec"),
+            "straggler": bool(s),
+            "straggler_count": int(s.get("count", 0)),
+            "straggler_persistent": bool(s.get("persistent", False)),
+            "straggler_zscore": s.get("last_zscore"),
+        })
+
+    stragglers = sorted(h for h, s in straggler_info.items())
+    persistent = sorted(h for h, s in straggler_info.items()
+                        if s.get("persistent"))
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "hosts": rows,
+        "n_hosts": len(rows),
+        "fleet_stats": (breakdown or {}).get("stats"),
+        "stragglers": stragglers,
+        "persistent_stragglers": persistent,
+        "breakdown_step": (breakdown or {}).get("step"),
+        "trace_files": {h or "local": p
+                        for h, p in found["traces"].items()},
+    }
+
+
+def merge_timeline(trace_paths: Dict[Optional[str], str]) -> Dict[str, Any]:
+    """One clock-aligned Perfetto document from per-host traces: each
+    host's events shift onto a common time axis via the ``wall_epoch``
+    anchor its tracer stamped, and land in their own process row (pid =
+    host index, named by a process_name metadata event)."""
+    docs = []
+    for host, path in sorted(trace_paths.items(),
+                             key=lambda kv: kv[0] or ""):
+        doc = load_trace(path)
+        meta = doc.get("metadata") or {}
+        label = meta.get("host") or host or \
+            os.path.splitext(os.path.basename(path))[0]
+        wall = meta.get("wall_epoch")
+        docs.append((label, float(wall) if wall else None,
+                     doc.get("traceEvents", [])))
+    if not docs:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    # Anchorless traces (pre-fleet files, bare-array variants) stay
+    # base-aligned instead of poisoning the base with epoch 0 — which
+    # would shift every anchored host by ~the unix epoch.
+    anchors = [w for _, w, _ in docs if w is not None]
+    base = min(anchors) if anchors else 0.0
+    merged: List[Dict[str, Any]] = []
+    for pid, (label, wall, events) in enumerate(docs):
+        shift_us = ((wall - base) * 1e6) if wall is not None else 0.0
+        merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue                 # replaced by the host-named row
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + shift_us
+            merged.append(ev)
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "metadata": {"aligned_to_wall_epoch": base if anchors else None,
+                         "hosts": [l for l, _, _ in docs]}}
+
+
+def scan_profile_dir(profile_dir: str) -> Dict[str, Dict[str, float]]:
+    """Measured collective vs total device time per ``jax.profiler``
+    perfetto capture (``**/*.trace.json.gz``) — the ground truth the
+    modeled ``comm/exposed_frac`` is checked against."""
+    out: Dict[str, Dict[str, float]] = {}
+    pattern = os.path.join(profile_dir, "**", "*.trace.json.gz")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        try:
+            with gzip.open(path, "rt") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        events = (doc.get("traceEvents", [])
+                  if isinstance(doc, dict) else doc)
+        total = coll = 0.0
+        for ev in events:
+            if ev.get("ph") != "X":
+                continue
+            dur = float(ev.get("dur", 0.0))
+            total += dur
+            if COLLECTIVE_RE.search(ev.get("name", "")):
+                coll += dur
+        rel = os.path.relpath(path, profile_dir)
+        out[rel] = {"collective_ms": coll / 1e3, "total_ms": total / 1e3,
+                    "collective_frac": (coll / total) if total > 0 else 0.0}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+def _fmt(v, spec, na="n/a"):
+    return format(v, spec) if v is not None else na
+
+
+def render(report: Dict[str, Any]) -> str:
+    out = [f"fleet report — {report['n_hosts']} host(s) "
+           f"({report['run_dir']})"]
+    out.append("")
+    hdr = (f"{'host':<16} {'steps':>6} {'wall s':>9} {'goodput':>8} "
+           f"{'mfu':>7} {'step s':>8} {'exposed':>8} {'straggler':>16}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in report["hosts"]:
+        if r["straggler"]:
+            verdict = (f"YES x{r['straggler_count']}"
+                       + (" (persistent)" if r["straggler_persistent"]
+                          else ""))
+        else:
+            verdict = "no"
+        out.append(
+            f"{r['host']:<16} {r['steps_committed']:>6} "
+            f"{r['wall_sec']:>9.1f} "
+            f"{_fmt(r['goodput_frac'], '.1%'):>8} "
+            f"{_fmt(r['mfu'], '.1%'):>7} "
+            f"{_fmt(r['mean_step_time_sec'], '.3f'):>8} "
+            f"{_fmt(r['exposed_frac'], '.1%'):>8} {verdict:>16}")
+    stats = report.get("fleet_stats")
+    if stats:
+        out.append("")
+        out.append(f"fleet spread (flush @ step {report['breakdown_step']}):")
+        for field, s in stats.items():
+            out.append(
+                f"  {field:<20} min {s['min']:>12.4g}  "
+                f"median {s['median']:>12.4g}  max {s['max']:>12.4g}  "
+                f"argmax {s.get('argmax_host_name', s['argmax_host'])}")
+    if report.get("persistent_stragglers"):
+        out.append("")
+        out.append("persistent straggler(s): "
+                   + ", ".join(report["persistent_stragglers"]))
+    profile = report.get("profile")
+    if profile:
+        out.append("")
+        out.append("measured collectives (jax.profiler captures):")
+        for name, p in profile.items():
+            out.append(f"  {name}: {p['collective_ms']:.1f} ms collective "
+                       f"of {p['total_ms']:.1f} ms device "
+                       f"({p['collective_frac']:.1%})")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Selftest
+# ---------------------------------------------------------------------------
+
+def _write(path: str, doc: Any) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def _selftest() -> int:
+    """Synthesize a 2-host run dir (manifests + host-scoped metrics +
+    per-host traces with offset wall anchors + a breakdown naming hostB a
+    persistent straggler), merge, and assert the properties the report is
+    trusted for: the straggler verdict names the right host, per-host
+    goodput/MFU/exposed-frac carry through, and the merged timeline is
+    clock-aligned (hostB's spans shift by its wall-anchor offset)."""
+    with tempfile.TemporaryDirectory() as td:
+        for host, mfu, prod in (("hostA", 0.30, 40.0), ("hostB", 0.28, 38.0)):
+            _write(os.path.join(td, f"run_manifest.a0000.{host}.json"), {
+                "format": 1, "run_id": "cafe01", "attempt": 0, "host": host,
+                "start_wall": 1000.0, "end_wall": 1062.0, "wall_sec": 62.0,
+                "exit_rc": 0, "restart_cause": "clean",
+                "categories": {"productive_step": prod, "data_stall": 4.0,
+                               "recompile": 8.0, "init_restore": 5.0},
+                "aux": {"exposed_comm_sec": 6.0},
+                "first_step": 1, "steps_committed": 30,
+                "mean_step_time_sec": prod / 30, "mfu": mfu, "n_chips": 4})
+        for host, frac in (("hostA", 0.12), ("hostB", 0.15)):
+            with open(os.path.join(td, f"metrics.{host}.jsonl"), "w") as f:
+                f.write(json.dumps({"tag": "comm/exposed_frac",
+                                    "value": frac, "step": 30,
+                                    "kind": "gauge"}) + "\n")
+                f.write(json.dumps({"tag": "engine/mfu", "value": 0.30,
+                                    "step": 30, "kind": "gauge"}) + "\n")
+                f.write('{"tag": "torn')          # must be tolerated
+        _write(os.path.join(td, "fleet_breakdown.json"), {
+            "format": 1, "step": 30, "hosts": ["hostA", "hostB"],
+            "fields": {"step_time_sec": [1.0, 1.5]},
+            "stats": {"step_time_sec": {
+                "min": 1.0, "median": 1.25, "max": 1.5,
+                "argmax_host": 1, "argmax_host_name": "hostB"}},
+            "stragglers": {"hostB": {"count": 3, "persistent": True,
+                                     "last_zscore": 4.2}},
+            "window": 8, "zscore_threshold": 3.0})
+        for host, wall_epoch in (("hostA", 1000.0), ("hostB", 1005.0)):
+            _write(os.path.join(td, f"trace.{host}.json"), {
+                "traceEvents": [
+                    {"name": "train_step", "ph": "X", "pid": 1, "tid": 1,
+                     "ts": 0.0, "dur": 1.0e6},
+                ],
+                "displayTimeUnit": "ms",
+                "metadata": {"wall_epoch": wall_epoch, "host": host}})
+
+        report = merge_fleet(td)
+        report["profile"] = {}
+        text = render(report)
+        timeline = merge_timeline(
+            {h: p for h, p in report["trace_files"].items()})
+
+    assert report["n_hosts"] == 2, report["hosts"]
+    by_host = {r["host"]: r for r in report["hosts"]}
+    # straggler verdict names the right host — and only it
+    assert by_host["hostB"]["straggler"] and \
+        by_host["hostB"]["straggler_persistent"]
+    assert not by_host["hostA"]["straggler"]
+    assert report["persistent_stragglers"] == ["hostB"]
+    # goodput / mfu / exposed carried through per host
+    assert abs(by_host["hostA"]["goodput_frac"] - 40.0 / 62.0) < 1e-9
+    assert abs(by_host["hostB"]["mfu"] - 0.28) < 1e-9
+    assert abs(by_host["hostB"]["exposed_frac"] - 0.15) < 1e-9
+    # breakdown step times preferred over manifest means
+    assert by_host["hostB"]["mean_step_time_sec"] == 1.5
+    # merged timeline: clock-aligned — hostB's span shifted by +5 s
+    spans = [e for e in timeline["traceEvents"] if e.get("ph") == "X"]
+    by_pid = {e["pid"]: e for e in spans}
+    assert abs(by_pid[0]["ts"] - 0.0) < 1e-6
+    assert abs(by_pid[1]["ts"] - 5.0e6) < 1e-6
+    names = {e["args"]["name"] for e in timeline["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert {"hostA", "hostB"} <= names
+    assert "hostB" in text and "persistent" in text
+    print(text)
+    print("\nselftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", nargs="?",
+                    help="the job's telemetry.dir (per-host metrics/"
+                         "traces, run manifests, fleet breakdown)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged report as JSON")
+    ap.add_argument("--timeline", metavar="OUT",
+                    help="also write the clock-aligned merged Perfetto "
+                         "trace to OUT")
+    ap.add_argument("--profile-dir",
+                    help="jax.profiler dir: parse *.trace.json.gz "
+                         "captures for measured collective time")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in 2-host round-trip check")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.run_dir:
+        ap.error("run dir required (or --selftest)")
+    report = merge_fleet(args.run_dir)
+    if args.profile_dir:
+        report["profile"] = scan_profile_dir(args.profile_dir)
+    if args.timeline:
+        timeline = merge_timeline(
+            {h: p for h, p in report["trace_files"].items()})
+        with open(args.timeline, "w") as f:
+            json.dump(timeline, f)
+        print(f"[fleet_report] merged timeline -> {args.timeline} "
+              f"({len(timeline['traceEvents'])} events)", file=sys.stderr)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,11 +79,21 @@ def load_manifests(run_dir: str) -> List[Dict[str, Any]]:
 
 def load_goodput_metrics(run_dir: str, metrics_file: str) -> Dict[Any, float]:
     """Last value per (attempt, tag) for goodput/* and engine/mfu rows —
-    the gauges are cumulative, so last-write-wins is the freshest total."""
-    path = os.path.join(run_dir, metrics_file)
+    the gauges are cumulative, so last-write-wins is the freshest total.
+    Multi-host runs host-scope the filename (``metrics.<host>.jsonl``);
+    every matching file is read."""
+    import glob as _glob
+    root, ext = os.path.splitext(metrics_file)
+    paths = sorted(set(
+        _glob.glob(os.path.join(run_dir, metrics_file))
+        + _glob.glob(os.path.join(run_dir, f"{root}.*{ext}"))))
     latest: Dict[Any, float] = {}
-    if not os.path.isfile(path):
-        return latest
+    for path in paths:
+        _load_one_metrics_file(path, latest)
+    return latest
+
+
+def _load_one_metrics_file(path: str, latest: Dict[Any, float]) -> None:
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -98,7 +108,6 @@ def load_goodput_metrics(run_dir: str, metrics_file: str) -> Dict[Any, float]:
                 continue
             attempt = int(row.get("attempt", 0))
             latest[(attempt, tag)] = float(row.get("value", 0.0))
-    return latest
 
 
 # ---------------------------------------------------------------------------
@@ -122,6 +131,23 @@ def _merge_attempt(manifests: List[Dict[str, Any]],
         mv = metrics.get((attempt, f"goodput/{c}_sec"))
         if mv is not None:
             cats[c] = max(cats[c], mv)
+    # Auxiliary sub-attributions (goodput aux gauges: pipe_bubble_sec,
+    # exposed_comm_sec, straggler_sec, ...): cumulative like the
+    # categories but OVERLAPPING productive_step, so they merge into
+    # their own table. Manifest "aux" + any non-category goodput/* metric
+    # row; max = freshest.
+    aux: Dict[str, float] = {}
+    for m in manifests:
+        for k, v in (m.get("aux") or {}).items():
+            aux[k] = max(aux.get(k, 0.0), float(v or 0.0))
+    non_aux = {f"{c}_sec" for c in CATEGORIES} | {
+        "wall_sec", "goodput_frac", "steps_committed"}
+    for (att, tag), v in metrics.items():
+        if att != attempt or not tag.startswith("goodput/"):
+            continue
+        name = tag[len("goodput/"):]
+        if name not in non_aux:
+            aux[name] = max(aux.get(name, 0.0), float(v))
     starts = [m.get("start_wall") for m in manifests
               if m.get("start_wall") is not None]
     ends = [m.get("end_wall") for m in manifests
@@ -152,6 +178,7 @@ def _merge_attempt(manifests: List[Dict[str, Any]],
         "end_wall": end_wall,
         "wall_sec": wall,
         "categories": cats,
+        "aux": aux,
         "first_step": min(first_steps) if first_steps else None,
         "steps_committed": max((int(m.get("steps_committed") or 0)
                                 for m in manifests), default=0),
@@ -203,6 +230,9 @@ def merge_run(run_dir: str,
 
     totals = {c: sum(a["categories"].get(c, 0.0) for a in attempts)
               for c in CATEGORIES}
+    aux_keys = sorted({k for a in attempts for k in a.get("aux", {})})
+    sub_attributions = {k: sum(a.get("aux", {}).get(k, 0.0)
+                               for a in attempts) for k in aux_keys}
     attempt_wall = sum(a["wall_sec"] for a in attempts)
     starts = [a["start_wall"] for a in attempts
               if a["start_wall"] is not None]
@@ -238,6 +268,7 @@ def merge_run(run_dir: str,
         "n_restarts": len(attempts) - 1,
         "wall_sec": run_wall,
         "categories": totals,
+        "sub_attributions": sub_attributions,
         "restart_sec": restart_sec,
         "unaccounted_sec": unaccounted,
         "attributed_frac": attributed,
@@ -278,6 +309,16 @@ def render(report: Dict[str, Any]) -> str:
     rows.append(("unaccounted", report["unaccounted_sec"]))
     for name, sec in rows:
         out.append(f"{name:<20} {sec:>12.3f} {sec / wall:>7.1%}")
+    subs = {k: v for k, v in (report.get("sub_attributions") or {}).items()
+            if v > 0.0}
+    if subs:
+        # Overlap productive_step (pipe bubbles, exposed collectives,
+        # straggler wait) — the time the ROADMAP overlap/elasticity work
+        # claws back; NOT part of the wall-clock partition above.
+        out.append("")
+        out.append("sub-attributions (inside productive_step):")
+        for name, sec in sorted(subs.items(), key=lambda kv: -kv[1]):
+            out.append(f"  {name:<18} {sec:>12.3f} {sec / wall:>7.1%}")
     out.append("")
     out.append("restarts:")
     hdr = (f"  {'attempt':>7} {'rc':>5} {'cause':<11} {'steps':>6} "
@@ -323,6 +364,7 @@ def _selftest() -> int:
             "categories": {"productive_step": 40.0, "data_stall": 4.0,
                            "recompile": 8.0, "ckpt_snapshot": 2.0,
                            "init_restore": 5.0, "idle_other": 1.0},
+            "aux": {"exposed_comm_sec": 6.0, "straggler_sec": 2.0},
             "first_step": 1, "steps_committed": 30,
             "mean_step_time_sec": 1.0, "mfu": 0.30, "n_chips": 8})
         # Attempt 1: spawned 2 s later (backoff), restored step 25,
@@ -335,6 +377,7 @@ def _selftest() -> int:
             "categories": {"productive_step": 44.0, "data_stall": 3.0,
                            "recompile": 6.0, "ckpt_snapshot": 2.0,
                            "init_restore": 10.0, "idle_other": 1.0},
+            "aux": {"exposed_comm_sec": 7.0},
             "first_step": 26, "steps_committed": 60,
             "mean_step_time_sec": 1.0, "mfu": 0.34, "n_chips": 8})
         with open(os.path.join(td, DEFAULT_METRICS_FILE), "w") as f:
@@ -364,6 +407,11 @@ def _selftest() -> int:
     assert report["attributed_frac"] > 0.95
     assert 0.0 < report["goodput_frac"] < 1.0
     assert report["categories"]["init_restore"] == 15.0
+    # sub-attributions: summed across attempts, rendered in their own
+    # overlap table (never part of the wall partition)
+    assert report["sub_attributions"]["exposed_comm_sec"] == 13.0
+    assert report["sub_attributions"]["straggler_sec"] == 2.0
+    assert "sub-attributions" in text and "exposed_comm_sec" in text
     # MFU: productive-time-weighted over both attempts, in (0.30, 0.34)
     assert 0.30 < report["mfu"] < 0.34, report["mfu"]
     assert "restarts:" in text and "preemption" in text

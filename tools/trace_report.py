@@ -10,31 +10,79 @@ events (retrace markers).
 Standalone on purpose: imports nothing beyond the stdlib, so it runs
 anywhere a trace file lands (including hosts without jax installed).
 
+Multiple traces (or a glob): every span row is prefixed with its source
+host (``hostA:train_step``) — from each file's ``metadata.host``, or the
+``trace.<host>.json`` filename component multi-host runs write — so one
+table covers a fleet until ``tools/fleet_report.py`` replaces it.
+
 Usage:
-    python tools/trace_report.py TRACE.json [--sort total|mean|count]
+    python tools/trace_report.py TRACE.json [...] [--sort total|mean|count]
+    python tools/trace_report.py 'run/telemetry/trace.*.json'
     python tools/trace_report.py --selftest
 """
 
 import argparse
+import glob as _glob
 import json
 import os
 import sys
 import tempfile
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
+
+
+def load_doc(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare-array Chrome trace variant
+        doc = {"traceEvents": doc}
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a Chrome trace (dict or list)")
+    events = doc.get("traceEvents", [])
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents is not a list")
+    return doc
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
-    with open(path) as f:
-        doc = json.load(f)
-    if isinstance(doc, dict):
-        events = doc.get("traceEvents", [])
-    elif isinstance(doc, list):  # bare-array Chrome trace variant
-        events = doc
-    else:
-        raise ValueError(f"{path}: not a Chrome trace (dict or list)")
-    if not isinstance(events, list):
-        raise ValueError(f"{path}: traceEvents is not a list")
+    return load_doc(path)["traceEvents"]
+
+
+def host_label(path: str, doc: Dict[str, Any]) -> str:
+    """Source-host label: trace metadata first, then the
+    ``<stem>.<host>.json`` filename component, then the file stem."""
+    host = (doc.get("metadata") or {}).get("host")
+    if host:
+        return str(host)
+    stem = os.path.basename(path)
+    if stem.endswith(".json"):
+        stem = stem[:-len(".json")]
+    parts = stem.split(".")
+    return parts[-1] if len(parts) > 1 else stem
+
+
+def load_many(paths: List[str]) -> List[Dict[str, Any]]:
+    """Load several trace files into one event list, each event's name
+    prefixed with its source host."""
+    events: List[Dict[str, Any]] = []
+    for path in paths:
+        doc = load_doc(path)
+        label = host_label(path, doc)
+        for ev in doc["traceEvents"]:
+            if "name" in ev and ev.get("ph") != "M":
+                ev = dict(ev)
+                ev["name"] = f"{label}:{ev['name']}"
+            events.append(ev)
     return events
+
+
+def expand_paths(args_traces: List[str]) -> List[str]:
+    """Expand glob patterns (quoted globs reach us unexpanded) and keep
+    explicit paths as-is."""
+    out: List[str] = []
+    for t in args_traces:
+        matches = sorted(_glob.glob(t))
+        out.extend(matches if matches else [t])
+    return out
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -147,6 +195,22 @@ def _selftest() -> int:
     assert "forward" in text and "share" in text
     top = max(summary["spans"], key=lambda r: r["total_ms"])
     assert top["name"] == "forward"
+    # multi-file path: span rows gain their source-host prefix (metadata
+    # host preferred, filename component as fallback)
+    with tempfile.TemporaryDirectory() as td:
+        for host, with_meta in (("hostA", True), ("hostB", False)):
+            with open(os.path.join(td, f"trace.{host}.json"), "w") as f:
+                doc = {"traceEvents": [
+                    {"name": "train_step", "ph": "X", "pid": 1, "tid": 1,
+                     "ts": 0.0, "dur": 1000.0}]}
+                if with_meta:
+                    doc["metadata"] = {"host": host}
+                json.dump(doc, f)
+        paths = expand_paths([os.path.join(td, "trace.*.json")])
+        assert len(paths) == 2, paths
+        multi = summarize(load_many(paths))
+    names = {r["name"] for r in multi["spans"]}
+    assert names == {"hostA:train_step", "hostB:train_step"}, names
     print(text)
     print("\nselftest ok")
     return 0
@@ -154,7 +218,9 @@ def _selftest() -> int:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", nargs="?", help="Chrome trace-event JSON file")
+    ap.add_argument("trace", nargs="*",
+                    help="Chrome trace-event JSON file(s) or glob; with "
+                         "more than one, rows are host-prefixed")
     ap.add_argument("--sort", choices=("total", "mean", "count"),
                     default="total")
     ap.add_argument("--json", action="store_true",
@@ -166,7 +232,10 @@ def main(argv=None) -> int:
         return _selftest()
     if not args.trace:
         ap.error("trace file required (or --selftest)")
-    summary = summarize(load_events(args.trace))
+    paths = expand_paths(args.trace)
+    events = (load_events(paths[0]) if len(paths) == 1
+              else load_many(paths))
+    summary = summarize(events)
     if args.json:
         print(json.dumps(summary, indent=1))
     else:
