@@ -278,6 +278,22 @@ def _is_transient(e) -> bool:
                                   "deadline", "unavailable"))
 
 
+def _record_headroom(name, result):
+    """Headroom (tightest device's bytes_limit − peak,
+    telemetry/memory.py) recorded AFTER each section. The peak is the
+    process-lifetime high-water mark (jax never resets it), so each
+    value is the margin left after everything run SO FAR — monotone
+    non-increasing across sections; the last section's value is the
+    run's overall minimum margin. None on backends without
+    memory_stats (CPU); never fails the section."""
+    try:
+        from deepspeed_tpu.telemetry.memory import min_headroom_bytes
+        result.setdefault("peak_headroom_bytes", {})[name] = \
+            min_headroom_bytes()
+    except Exception as e:  # noqa: BLE001 — accounting must not kill bench
+        log(f"[bench] WARNING: headroom record failed for {name!r}: {e}")
+
+
 def run_section(name, fn, result, retries=1):
     """Run one bench section; on a transient failure (tunnel
     JaxRuntimeError & co — see ``_is_transient``) retry once from scratch:
@@ -287,6 +303,7 @@ def run_section(name, fn, result, retries=1):
     for attempt in range(retries + 1):
         try:
             fn()
+            _record_headroom(name, result)
             _flush_partial(result)
             return True
         except Exception as e:  # noqa: BLE001 — isolate every section
@@ -348,6 +365,12 @@ def main():
         # a future fleet-on BENCH round must record its fleet block here
         # so rows stay attributable.
         "fleet": "off",
+        # Memory observatory (telemetry/memory.py) off: no per-step
+        # headroom gauges and no attribution AOT compile in the timed
+        # windows. Per-round peak headroom is still recorded under
+        # "peak_headroom_bytes" (a free post-section memory_stats read)
+        # so capacity regressions show up next to the throughput rows.
+        "memory": "off",
         "peak_tflops_per_chip": peak,
         # Gradient-sync strategy the rows were measured under
         # (comm/grad_sync.py): none of the bench configs set a comm

@@ -583,6 +583,72 @@ class TelemetryFleetConfig:
 
 
 @dataclass
+class TelemetryMemoryConfig:
+    """Memory observatory knobs (telemetry/memory.py): XLA memory
+    attribution + model-state ledger + capacity planner + OOM forensics.
+    Default off — enabled it adds one AOT lower+compile per step
+    function and per-step headroom gauges (riding the HBM stats fetch
+    the engine gauges already pay for); never any change to the step
+    jaxpr."""
+
+    enabled: bool = C.TELEMETRY_MEMORY_ENABLED_DEFAULT
+    headroom_warn_frac: float = C.TELEMETRY_MEMORY_HEADROOM_WARN_FRAC_DEFAULT
+    crashdump_dir: str = C.TELEMETRY_MEMORY_CRASHDUMP_DIR_DEFAULT
+    oom_exit_code: int = C.MEMORY_OOM_EXIT_CODE_DEFAULT
+    plan_at_init: bool = C.TELEMETRY_MEMORY_PLAN_AT_INIT_DEFAULT
+    plan_file: str = C.TELEMETRY_MEMORY_PLAN_FILE_DEFAULT
+    activation_bytes_per_sample: float = C.TELEMETRY_MEMORY_ACT_BYTES_DEFAULT
+    hbm_limit_gb: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> \
+            "TelemetryMemoryConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.TELEMETRY_MEMORY_ENABLED,
+                              C.TELEMETRY_MEMORY_ENABLED_DEFAULT)),
+            headroom_warn_frac=float(_get(
+                d, C.TELEMETRY_MEMORY_HEADROOM_WARN_FRAC,
+                C.TELEMETRY_MEMORY_HEADROOM_WARN_FRAC_DEFAULT)),
+            crashdump_dir=str(_get(d, C.TELEMETRY_MEMORY_CRASHDUMP_DIR,
+                                   C.TELEMETRY_MEMORY_CRASHDUMP_DIR_DEFAULT)),
+            oom_exit_code=int(_get(d, C.TELEMETRY_MEMORY_OOM_EXIT_CODE,
+                                   C.MEMORY_OOM_EXIT_CODE_DEFAULT)),
+            plan_at_init=bool(_get(d, C.TELEMETRY_MEMORY_PLAN_AT_INIT,
+                                   C.TELEMETRY_MEMORY_PLAN_AT_INIT_DEFAULT)),
+            plan_file=str(_get(d, C.TELEMETRY_MEMORY_PLAN_FILE,
+                               C.TELEMETRY_MEMORY_PLAN_FILE_DEFAULT)),
+            activation_bytes_per_sample=float(_get(
+                d, C.TELEMETRY_MEMORY_ACT_BYTES,
+                C.TELEMETRY_MEMORY_ACT_BYTES_DEFAULT)),
+            hbm_limit_gb=(float(d[C.TELEMETRY_MEMORY_HBM_LIMIT_GB])
+                          if d.get(C.TELEMETRY_MEMORY_HBM_LIMIT_GB)
+                          is not None else None),
+        )
+        if not (0.0 <= cfg.headroom_warn_frac <= 1.0):
+            raise ConfigError(
+                f"telemetry.memory.headroom_warn_frac must be in [0, 1], "
+                f"got {cfg.headroom_warn_frac}")
+        if not (1 <= cfg.oom_exit_code <= 255):
+            raise ConfigError(
+                f"telemetry.memory.oom_exit_code must be in [1, 255], got "
+                f"{cfg.oom_exit_code}")
+        if cfg.hbm_limit_gb is not None and cfg.hbm_limit_gb <= 0:
+            raise ConfigError(
+                f"telemetry.memory.hbm_limit_gb must be positive, got "
+                f"{cfg.hbm_limit_gb}")
+        # The planner file is discovered by pattern by the stdlib-only
+        # memory_report (same argument as fleet.breakdown_file).
+        if not (cfg.plan_file.startswith("memory_plan")
+                and cfg.plan_file.endswith(".json")):
+            raise ConfigError(
+                "telemetry.memory.plan_file must match 'memory_plan*.json' "
+                f"(tools/memory_report.py discovers it by that pattern), "
+                f"got '{cfg.plan_file}'")
+        return cfg
+
+
+@dataclass
 class TelemetryConfig:
     """Unified observability (telemetry/; docs/OBSERVABILITY.md): metrics
     registry + Chrome-trace step tracer + recompilation detector. Disabled
@@ -602,6 +668,10 @@ class TelemetryConfig:
     # Fleet observability (telemetry/fleet.py): cross-host aggregation +
     # straggler detection. Opt-in (adds a per-flush collective).
     fleet: TelemetryFleetConfig = field(default_factory=TelemetryFleetConfig)
+    # Memory observatory (telemetry/memory.py): XLA attribution, ledger,
+    # capacity planner, OOM forensics. Opt-in (adds one AOT compile).
+    memory: TelemetryMemoryConfig = field(
+        default_factory=TelemetryMemoryConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
@@ -617,6 +687,8 @@ class TelemetryConfig:
             goodput=bool(_get(d, C.TELEMETRY_GOODPUT,
                               C.TELEMETRY_GOODPUT_DEFAULT)),
             fleet=TelemetryFleetConfig.from_dict(d.get(C.TELEMETRY_FLEET)),
+            memory=TelemetryMemoryConfig.from_dict(
+                d.get(C.TELEMETRY_MEMORY)),
         )
         if cfg.enabled and not cfg.dir:
             raise ConfigError(
@@ -872,6 +944,18 @@ class DeepSpeedTPUConfig:
                               "use stage 1 (reference pipe/engine.py:56)")
         if self.fp16.enabled and self.amp_enabled:
             raise ConfigError("fp16 and amp cannot both be enabled")
+        if (self.telemetry.memory.enabled and self.guardrails.watchdog.enabled
+                and self.telemetry.memory.oom_exit_code
+                == self.guardrails.watchdog.exit_code):
+            # The supervisor maps the watchdog rc to an IMMEDIATE restart
+            # and the OOM rc to NO restart — one rc cannot mean both, and
+            # the collision would hot-loop every deterministic OOM.
+            raise ConfigError(
+                f"telemetry.memory.oom_exit_code "
+                f"({self.telemetry.memory.oom_exit_code}) collides with "
+                f"guardrails.watchdog.exit_code — the supervisor restarts "
+                f"watchdog exits immediately but must NOT restart OOM "
+                f"exits; pick distinct codes")
 
     # convenience accessors mirroring the reference's getters ------------------
     @property

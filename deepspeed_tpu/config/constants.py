@@ -195,6 +195,30 @@ TELEMETRY_FLEET_PERSIST = "persist"
 TELEMETRY_FLEET_PERSIST_DEFAULT = 3           # verdicts until "persistent"
 TELEMETRY_FLEET_BREAKDOWN_FILE = "breakdown_file"
 TELEMETRY_FLEET_BREAKDOWN_FILE_DEFAULT = "fleet_breakdown.json"
+# Memory observatory (telemetry/memory.py): XLA memory attribution +
+# model-state ledger + capacity planner + OOM forensics. Default OFF:
+# enabled it adds one AOT lower+compile per step function (attribution)
+# and per-step headroom gauges — reserved for explicit opt-in like fleet.
+TELEMETRY_MEMORY = "memory"
+TELEMETRY_MEMORY_ENABLED = "enabled"
+TELEMETRY_MEMORY_ENABLED_DEFAULT = False
+TELEMETRY_MEMORY_HEADROOM_WARN_FRAC = "headroom_warn_frac"
+TELEMETRY_MEMORY_HEADROOM_WARN_FRAC_DEFAULT = 0.1   # warn below 10% of HBM
+TELEMETRY_MEMORY_CRASHDUMP_DIR = "crashdump_dir"
+TELEMETRY_MEMORY_CRASHDUMP_DIR_DEFAULT = "crashdumps"
+TELEMETRY_MEMORY_OOM_EXIT_CODE = "oom_exit_code"
+TELEMETRY_MEMORY_PLAN_AT_INIT = "plan_at_init"
+TELEMETRY_MEMORY_PLAN_AT_INIT_DEFAULT = True
+TELEMETRY_MEMORY_PLAN_FILE = "plan_file"
+TELEMETRY_MEMORY_PLAN_FILE_DEFAULT = "memory_plan.json"
+TELEMETRY_MEMORY_ACT_BYTES = "activation_bytes_per_sample"
+TELEMETRY_MEMORY_ACT_BYTES_DEFAULT = 0.0
+TELEMETRY_MEMORY_HBM_LIMIT_GB = "hbm_limit_gb"
+# Distinct from rc 113 (watchdog: immediate restart) by design: the
+# supervisor maps THIS rc to cause=oom and does NOT restart at all — a
+# deterministic OOM is a config bug, and a hot restart loop would just
+# re-OOM until the budget is gone.
+MEMORY_OOM_EXIT_CODE_DEFAULT = 114
 
 #############################################
 # Logging / misc

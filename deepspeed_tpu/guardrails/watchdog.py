@@ -170,19 +170,29 @@ class StepWatchdog:
             except Exception as e:  # noqa: BLE001
                 info["trace_tail_error"] = repr(e)
 
-        # 3. Tail of the metrics JSONL (last scalar lines before the hang).
-        if self.metrics_tail_of and os.path.exists(self.metrics_tail_of):
-            try:
-                with open(self.metrics_tail_of, "rb") as f:
-                    f.seek(0, os.SEEK_END)
-                    f.seek(max(0, f.tell() - 64 * 1024))
-                    tail = f.read().decode("utf-8", errors="replace")
-                lines = tail.splitlines()[-100:]
-                with open(os.path.join(out, "metrics_tail.jsonl"), "w") as f:
-                    f.write("\n".join(lines) + "\n")
-                info["metrics_tail"] = "metrics_tail.jsonl"
-            except Exception as e:  # noqa: BLE001
-                info["metrics_tail_error"] = repr(e)
+        # 3. All-device memory stats + headroom (shared artifact with the
+        # memory observatory's OOM crashdump): a hung collective under
+        # memory pressure (allocator thrash, a peer that OOM-killed
+        # mid-allreduce) looks identical to a network hang without this.
+        try:
+            from deepspeed_tpu.telemetry.memory import \
+                collect_memory_snapshot
+            with open(os.path.join(out, "memory.json"), "w") as f:
+                json.dump(collect_memory_snapshot(), f, indent=1)
+            info["memory"] = "memory.json"
+        except Exception as e:  # noqa: BLE001
+            info["memory_error"] = repr(e)
+
+        # 4. Tail of the metrics JSONL (last scalar lines before the
+        # hang) — the shared crashdump artifact (telemetry/memory.py
+        # write_metrics_tail, same as the OOM dump).
+        try:
+            from deepspeed_tpu.telemetry.memory import write_metrics_tail
+            name = write_metrics_tail(out, self.metrics_tail_of)
+            if name:
+                info["metrics_tail"] = name
+        except Exception as e:  # noqa: BLE001
+            info["metrics_tail_error"] = repr(e)
 
         with open(os.path.join(out, "info.json"), "w") as f:
             json.dump(info, f, indent=1)

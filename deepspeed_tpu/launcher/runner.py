@@ -67,6 +67,12 @@ def parse_args(args=None):
                              "kill (immediate no-backoff restart). Set "
                              "this when the ds-config overrides "
                              "guardrails.watchdog.exit_code; default 113")
+    parser.add_argument("--oom_rc", type=int, default=None,
+                        help="Exit code treated as a memory-observatory "
+                             "OOM (cause=oom, NO restart — a "
+                             "deterministic OOM is a config bug). Set "
+                             "this when the ds-config overrides "
+                             "telemetry.memory.oom_exit_code; default 114")
     parser.add_argument("--run_dir", type=str, default=None,
                         help="Goodput run dir (the job's telemetry.dir): "
                              "with --auto_resume, each attempt's run "
@@ -239,6 +245,13 @@ def propagated_env() -> Dict[str, str]:
 
 def main(args=None):
     args = parse_args(args)
+    # ONE resolution of the effective OOM rc (telemetry/memory.py's
+    # distinct exit code) — the supervisor branch, the manifest cause
+    # classification and the auto-resume loop below must all agree on
+    # which rc means "deterministic OOM, do not restart".
+    from deepspeed_tpu.config.constants import MEMORY_OOM_EXIT_CODE_DEFAULT
+    oom_rc = (args.oom_rc if args.oom_rc is not None
+              else MEMORY_OOM_EXIT_CODE_DEFAULT)
     resources = fetch_hostfile(args.hostfile)
     if not resources:
         # single-node fallback: localhost with all local chips
@@ -267,6 +280,7 @@ def main(args=None):
             sys.exit(Supervisor(cmd, max_restarts=args.max_restarts,
                                 max_backoff=args.max_backoff,
                                 immediate_restart_rcs=immediate,
+                                oom_rcs={oom_rc},
                                 run_dir=args.run_dir,
                                 env=env).run())
         result = subprocess.run(cmd, env={**os.environ, **env})
@@ -324,7 +338,8 @@ def main(args=None):
             else (GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT,)
         try:
             finalize_attempt_manifests(args.run_dir, attempt, rc_,
-                                       classify_exit(rc_, watchdog),
+                                       classify_exit(rc_, watchdog,
+                                                     (oom_rc,)),
                                        start_wall, time.time())
         except Exception as e:  # noqa: BLE001
             logger.warning("goodput manifest finalize failed: %s", e)
@@ -333,7 +348,8 @@ def main(args=None):
     rc = launch_once({ATTEMPT_START_WALL_ENV: repr(t_start)})
     finalize_attempt(0, rc, t_start)
     restarts = 0
-    while rc != 0 and args.auto_resume and restarts < args.max_restarts:
+    while (rc != 0 and rc != oom_rc and args.auto_resume
+           and restarts < args.max_restarts):
         restarts += 1
         from deepspeed_tpu.config.constants import \
             GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT
@@ -354,6 +370,12 @@ def main(args=None):
         rc = launch_once({RESUME_ATTEMPT_ENV: str(restarts),
                           ATTEMPT_START_WALL_ENV: repr(t_start)})
         finalize_attempt(restarts, rc, t_start)
+    if rc == oom_rc and args.auto_resume:
+        logger.error(
+            "job died rc=%s (cause=oom) — NOT restarting: a deterministic "
+            "OOM re-fires every attempt; inspect the memory crashdump "
+            "(oom_step*/) and the memory_plan.json what-if table "
+            "(tools/memory_report.py) for a fitting config", rc)
     sys.exit(rc)
 
 

@@ -69,6 +69,11 @@ class PipelineEngine(TPUEngine):
         # span (train_batch below); the base engine's inner train_step
         # note must stay off or the two would average.
         self._fleet_note_inner_span = False
+        # An OOM crashdump from this engine names the pipeline shape —
+        # the first thing a memory post-mortem of a staged schedule asks
+        # (same label convention as the watchdog bracket below).
+        self._memory_oom_label = (f"pipe_step[stages={self.num_stages},"
+                                  f"mb={self.micro_batches}]")
         log_dist(f"PipelineEngine: stages={self.num_stages} "
                  f"micro_batches={self.micro_batches}", ranks=[0])
 

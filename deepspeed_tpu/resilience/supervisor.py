@@ -22,8 +22,8 @@ import sys
 import time
 from typing import Callable, Dict, Iterable, List, Optional
 
-from deepspeed_tpu.config.constants import \
-    GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT
+from deepspeed_tpu.config.constants import (
+    GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT, MEMORY_OOM_EXIT_CODE_DEFAULT)
 from deepspeed_tpu.guardrails.retry import backoff_delay
 from deepspeed_tpu.resilience.fault import RESUME_ATTEMPT_ENV
 from deepspeed_tpu.utils.logging import logger
@@ -44,6 +44,12 @@ class Supervisor:
     (by default the guardrails watchdog's distinct rc) restart with NO
     delay: a watchdog kill means the job already sat through a full step
     deadline doing nothing — backing off on top would double the waste.
+    Exit codes in ``oom_rcs`` (by default the memory observatory's
+    distinct OOM rc, telemetry/memory.py) are NOT restarted at all: a
+    deterministic RESOURCE_EXHAUSTED is a config bug — the same model on
+    the same devices re-OOMs on every attempt, so a restart loop just
+    burns the budget re-compiling into the same wall. The attempt's run
+    manifest is stamped ``cause=oom`` and the loop ends with that rc.
     """
 
     def __init__(self,
@@ -54,6 +60,7 @@ class Supervisor:
                  max_backoff: float = MAX_RESTART_BACKOFF_DEFAULT,
                  jitter: float = 0.25,
                  immediate_restart_rcs: Optional[Iterable[int]] = None,
+                 oom_rcs: Optional[Iterable[int]] = None,
                  ckpt_dir: Optional[str] = None,
                  run_dir: Optional[str] = None,
                  available_worlds: Optional[Callable[[int], int]] = None):
@@ -68,6 +75,8 @@ class Supervisor:
         self.immediate_restart_rcs = set(
             immediate_restart_rcs if immediate_restart_rcs is not None
             else (GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT,))
+        self.oom_rcs = set(oom_rcs if oom_rcs is not None
+                           else (MEMORY_OOM_EXIT_CODE_DEFAULT,))
         self.ckpt_dir = ckpt_dir
         # Goodput run dir (the child's telemetry.dir): when set, each
         # attempt's run manifest gets its exit rc / restart cause stamped
@@ -77,6 +86,7 @@ class Supervisor:
         self.available_worlds = available_worlds
         self.restarts = 0
         self.immediate_restarts = 0
+        self.oom_exits = 0
         self.exit_codes: List[int] = []
         # Hosts the fleet layer marked as persistent stragglers (read from
         # the run dir's fleet breakdown after each attempt) — surfaced in
@@ -114,7 +124,7 @@ class Supervisor:
         try:
             finalize_attempt_manifests(
                 self.run_dir, attempt, rc,
-                classify_exit(rc, self.immediate_restart_rcs),
+                classify_exit(rc, self.immediate_restart_rcs, self.oom_rcs),
                 start_wall, time.time())
         except Exception as e:  # noqa: BLE001
             logger.warning("supervisor: manifest finalize failed: %s", e)
@@ -164,6 +174,25 @@ class Supervisor:
                         "Train/Resilience/recovery_count", self.restarts,
                         attempt)
                 return 0
+            if rc in self.oom_rcs:
+                # A deterministic OOM is a CONFIG bug, not a preemption:
+                # the same state on the same devices re-OOMs every
+                # attempt, so restarting (hot or backed-off) only burns
+                # the budget. The crashdump + what-if table say what to
+                # change; stop here with the distinct rc.
+                self.oom_exits += 1
+                logger.error(
+                    "supervisor: attempt %d exited rc=%d (cause=oom) — "
+                    "NOT restarting: a deterministic OOM re-fires every "
+                    "attempt. Inspect the memory crashdump (oom_step*/ "
+                    "under the crashdump dir) and the memory_plan.json "
+                    "what-if table (tools/memory_report.py) for a "
+                    "fitting ZeRO stage / offload / microbatch", attempt,
+                    rc)
+                if self.metrics is not None:
+                    self.metrics.add_scalar(
+                        "Train/Resilience/worker_exit_code", rc, attempt)
+                return rc
             if self.restarts >= self.max_restarts:
                 logger.error(
                     "supervisor: attempt %d exited rc=%d and the restart "
@@ -211,6 +240,11 @@ def supervise_main(argv: Optional[List[str]] = None) -> int:
                          " default: the guardrails watchdog rc 113. Set "
                          "when the ds-config overrides "
                          "guardrails.watchdog.exit_code")
+    ap.add_argument("--oom_rc", type=int, action="append", default=None,
+                    help="Exit code classified cause=oom and NOT restarted "
+                         "(repeatable); default: the memory observatory rc "
+                         "114. Set when the ds-config overrides "
+                         "telemetry.memory.oom_exit_code")
     ap.add_argument("--checkpoint_dir", type=str, default=None)
     ap.add_argument("--run_dir", type=str, default=None,
                     help="Goodput run dir (the child's telemetry.dir): "
@@ -225,6 +259,7 @@ def supervise_main(argv: Optional[List[str]] = None) -> int:
     return Supervisor(cmd, max_restarts=args.max_restarts,
                       backoff=args.backoff, max_backoff=args.max_backoff,
                       immediate_restart_rcs=args.immediate_rc,
+                      oom_rcs=args.oom_rc,
                       ckpt_dir=args.checkpoint_dir,
                       run_dir=args.run_dir).run()
 

@@ -28,6 +28,7 @@ adapters for flax modules live in ``deepspeed_tpu.models.adapter``.
 """
 
 import collections
+import contextlib
 import os
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
@@ -335,11 +336,27 @@ class TPUEngine:
         from deepspeed_tpu.telemetry.fleet import build_fleet
         self.fleet = build_fleet(config.telemetry, telemetry=self.telemetry,
                                  goodput=self.goodput)
+        # Memory observatory (telemetry/memory.py): XLA memory attribution
+        # + model-state ledger + capacity planner + OOM forensics.
+        # Disabled (the default) => None, every hook one attribute check,
+        # and the step jaxpr is bit-identical — the observatory never
+        # touches the jitted step functions.
+        from deepspeed_tpu.telemetry.memory import build_memory_observatory
+        self.memory = build_memory_observatory(
+            config.telemetry, telemetry=self.telemetry, goodput=self.goodput)
+        if self.memory is not None:
+            # Pre-compile: ledger gauges + the stage×offload×microbatch
+            # what-if table (loud warning when the chosen config projects
+            # over HBM) — pure host arithmetic over shapes/specs.
+            self.memory.on_engine_init(self)
         # Whether _train_batch_inner's train_step span feeds the fleet
         # step-time estimate. The pipeline engine turns this off and
         # feeds its OUTER pipe_step span instead — otherwise both spans
         # would be averaged and under-report the schedule overhead.
         self._fleet_note_inner_span = True
+        # Label an OOM crashdump carries for this engine's fused step
+        # (the pipeline engine overrides it with the schedule shape).
+        self._memory_oom_label = "train_step"
         self.moq = None
         if config.quantize_training.get("enabled", False):
             if self._offload_cfg.enabled and self._offload_cfg.device == "nvme":
@@ -1353,7 +1370,10 @@ class TPUEngine:
             g.mark("data_stall")
         status = tel.check_recompile("engine.micro_step", batch,
                                      step=self.global_steps)
-        with tel.span("forward", step=self.global_steps):
+        oom_guard = (self.memory.oom_guard(self, label="micro_step")
+                     if self.memory is not None
+                     else contextlib.nullcontext())
+        with tel.span("forward", step=self.global_steps), oom_guard:
             self.state, loss, _ = self._micro_step(self.state, batch)
         if g is not None:
             # Same classification as _goodput_step_mark: micro-steps
@@ -1443,8 +1463,11 @@ class TPUEngine:
             if self.wall_clock_breakdown:
                 self.timers("step").start()
             lr = self._current_lr()
+            oom_guard = (self.memory.oom_guard(self, label="optimizer_step")
+                         if self.memory is not None
+                         else contextlib.nullcontext())
             with self.telemetry.span("optimizer_step",
-                                     step=self.global_steps):
+                                     step=self.global_steps), oom_guard:
                 self.state, overflow, norm = self._apply_step(self.state, lr)
             self._micro_in_window = 0
             self.global_steps += 1
@@ -1481,7 +1504,7 @@ class TPUEngine:
         # is set by its worst chip, and total in-use is the host's real
         # footprint. peak = max over devices, in_use = sum; rows carry the
         # device count so dashboards can tell a 1-chip host from an 8-chip.
-        peaks, in_use = [], []
+        peaks, in_use, limits = [], [], []
         try:
             devices = jax.local_devices()
         except Exception:  # noqa: BLE001 — backend may be gone at teardown
@@ -1494,11 +1517,16 @@ class TPUEngine:
             if stats:
                 peaks.append(stats.get("peak_bytes_in_use", 0))
                 in_use.append(stats.get("bytes_in_use", 0))
+                limits.append(stats.get("bytes_limit", 0))
         if peaks:
             tel.registry.gauge("engine/hbm_peak_bytes").set(
                 max(peaks), step=self.global_steps, devices=len(peaks))
             tel.registry.gauge("engine/hbm_bytes_in_use").set(
                 sum(in_use), step=self.global_steps, devices=len(peaks))
+        if self.memory is not None:
+            # Headroom gauges ride the SAME stats fetch — no extra device
+            # work (telemetry/memory.py note_hbm).
+            self.memory.note_hbm(peaks, limits, step=self.global_steps)
         if self.grad_sync_plan is not None:
             # comm/bytes_dcn, comm/bytes_ici, comm/compression_ratio —
             # modeled from the plan shape (no device sync; see
@@ -1686,8 +1714,17 @@ class TPUEngine:
         gr = self.guardrails
         if gr is not None:
             gr.step_begin(self.global_steps + 1)
+        # RESOURCE_EXHAUSTED in compile or dispatch => memory crashdump +
+        # distinct OOM rc (telemetry/memory.py). The pipeline engine
+        # overrides the label so an OOM mid-pipe names the schedule
+        # shape, like the watchdog bracket.
+        oom_guard = (self.memory.oom_guard(self,
+                                           label=self._memory_oom_label)
+                     if self.memory is not None
+                     else contextlib.nullcontext())
         try:
-            return self._train_batch_inner(batches)
+            with oom_guard:
+                return self._train_batch_inner(batches)
         finally:
             if gr is not None:
                 gr.step_end()
@@ -1725,6 +1762,10 @@ class TPUEngine:
             self.tput_timer.stop()
             self._last_loss = loss
             self._goodput_step_mark(status)
+            if self.memory is not None:
+                # Offload tier: attribute the device-side micro-scan
+                # executable (the host optimizer step has no HBM story).
+                self.memory.maybe_attribute(self, batches, None, status)
             if (self.fleet is not None and sp.duration
                     and self._fleet_note_inner_span
                     and tel.tracer.sync_spans):
@@ -1769,6 +1810,10 @@ class TPUEngine:
             # the goodput fallback is the honest estimate.
             self.fleet.note_step_time(sp.duration)
         self._maybe_goodput_cost_analysis(batches, lr)
+        if self.memory is not None:
+            # Once per compiled step fn (re-armed on retrace): XLA
+            # memory_analysis gauges for this executable.
+            self.memory.maybe_attribute(self, batches, lr, status)
         rolled_back = self._guardrails_step_hook(loss, overflow, norm)
         if self.config.check_numerics and not rolled_back:
             self._check_numerics(loss, overflow=bool(overflow))

@@ -139,17 +139,29 @@ def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
 # ---------------------------------------------------------------------------
 
 def see_memory_usage(message: str, force: bool = False) -> None:
-    """Log device + host memory (reference utils.py:578)."""
+    """Log device + host memory (reference utils.py:578).
+
+    Aggregates ALL local devices — same convention as the engine's HBM
+    gauges and the memory observatory: in-use is the summed host
+    footprint, peak is the worst chip (the OOM margin), limit is the
+    tightest chip's ``bytes_limit``."""
     if not force:
         return
     try:
-        dev = jax.local_devices()[0]
-        stats = dev.memory_stats() or {}
-        in_use = stats.get("bytes_in_use", 0) / (1024**3)
-        peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
-        limit = stats.get("bytes_limit", 0) / (1024**3)
-        logger.info(f"{message} | HBM in-use {in_use:.2f} GB, peak {peak:.2f} GB, "
-                    f"limit {limit:.2f} GB")
+        peaks, in_use, limits = [], [], []
+        for dev in jax.local_devices():
+            stats = dev.memory_stats() or {}
+            if stats:
+                peaks.append(stats.get("peak_bytes_in_use", 0))
+                in_use.append(stats.get("bytes_in_use", 0))
+                limits.append(stats.get("bytes_limit", 0))
+        if not peaks:
+            raise RuntimeError("no device reported memory stats")
+        limit = min((l for l in limits if l), default=0)
+        logger.info(
+            f"{message} | HBM in-use {sum(in_use) / 1024**3:.2f} GB, "
+            f"peak {max(peaks) / 1024**3:.2f} GB, "
+            f"limit {limit / 1024**3:.2f} GB ({len(peaks)} devices)")
     except Exception:
         logger.info(f"{message} | device memory stats unavailable")
     try:

@@ -26,6 +26,12 @@ from deepspeed_tpu.telemetry.goodput import (GOODPUT_METRIC_TAGS,
                                              GoodputAccountant,
                                              build_goodput)
 from deepspeed_tpu.telemetry.goodput import CATEGORIES as GOODPUT_CATEGORIES
+from deepspeed_tpu.telemetry.memory import (MEMORY_METRIC_TAGS,
+                                            MemoryObservatory,
+                                            build_memory_observatory,
+                                            collect_memory_snapshot,
+                                            model_state_ledger,
+                                            plan_capacity)
 from deepspeed_tpu.telemetry.recompile import (RECOMPILE_COUNTER,
                                                RecompileDetector,
                                                tree_signature)
@@ -38,11 +44,14 @@ from deepspeed_tpu.telemetry.tracer import StepTracer
 __all__ = [
     "Counter", "FLEET_METRIC_TAGS", "FleetAggregator", "Gauge",
     "GOODPUT_CATEGORIES", "GOODPUT_METRIC_TAGS", "GoodputAccountant",
-    "Histogram", "InMemorySink", "JSONLSink", "MetricsRegistry",
+    "Histogram", "InMemorySink", "JSONLSink", "MEMORY_METRIC_TAGS",
+    "MemoryObservatory", "MetricsRegistry",
     "RecompileDetector", "RECOMPILE_COUNTER", "Sink", "StepTracer",
     "Telemetry", "TensorboardSink", "build_fleet", "build_goodput",
-    "build_telemetry", "default_host", "host_scoped_path",
-    "telemetry_host_component", "tree_signature",
+    "build_memory_observatory", "build_telemetry",
+    "collect_memory_snapshot", "default_host", "host_scoped_path",
+    "model_state_ledger", "plan_capacity", "telemetry_host_component",
+    "tree_signature",
 ]
 
 

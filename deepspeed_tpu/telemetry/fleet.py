@@ -57,9 +57,15 @@ FLEET_FIELDS = (
     "hbm_peak_bytes",     # max peak over this host's local devices
     "productive_sec",     # goodput productive_step delta since last flush
     "exposed_comm_sec",   # modeled exposed-collective seconds (delta)
+    "hbm_headroom_bytes", # memory observatory headroom (min over devices;
+                          # 0 = not reported: telemetry.memory off or no
+                          # device bytes_limit)
 )
 
-_FLEET_STATS = ("min", "median", "max", "argmax_host")
+# argmin_host is the headroom field's reason to exist — fleet/
+# hbm_headroom_bytes_argmin_host NAMES the tightest host — and rides
+# every field (the fastest host is as interesting as the slowest).
+_FLEET_STATS = ("min", "median", "max", "argmax_host", "argmin_host")
 
 STRAGGLER_COUNTER = "telemetry/stragglers"
 STRAGGLER_INSTANT = "fleet/straggler"
@@ -278,17 +284,24 @@ class FleetAggregator:
         # Committed-step count is authoritative (an engine may note more
         # than one sync'd span per step — e.g. pipe_step + train_step).
         self._steps_delta = d_count if d_count > 0 else 1.0
-        hbm = 0.0
+        hbm = headroom = 0.0
         tel = self.telemetry
         if tel is not None:
             v = tel.registry.gauge("engine/hbm_peak_bytes").value
             hbm = float(v) if v else 0.0
+            # Set by the memory observatory (telemetry/memory.py) when
+            # telemetry.memory is on AND the devices report bytes_limit;
+            # 0 otherwise — the breakdown/report treat 0 as "not
+            # reported", never as "no headroom".
+            h = tel.registry.gauge("memory/hbm_headroom_bytes").value
+            headroom = float(h) if h else 0.0
         return np.array([
             step_time,
             max(0.0, cur["data_stall"] - prev["data_stall"]),
             hbm,
             max(0.0, cur["productive"] - prev["productive"]),
             max(0.0, cur["exposed"] - prev["exposed"]),
+            headroom,
         ], np.float32)
 
     # -- the flush-boundary hook ----------------------------------------
@@ -332,14 +345,20 @@ class FleetAggregator:
         # (tests, report tooling) defaults to leader semantics.
         leader = True if self._leader is None else bool(self._leader)
         stats: Dict[str, Dict[str, Any]] = {}
-        for j, field in enumerate(FLEET_FIELDS):
+        # Tolerate matrices narrower than FLEET_FIELDS: the wire layout
+        # is append-only, so rows gathered from an older writer simply
+        # lack the trailing fields (no stats for them).
+        for j, field in enumerate(FLEET_FIELDS[:matrix.shape[1]]):
             col = matrix[:, j]
             amax = int(np.argmax(col))
+            amin = int(np.argmin(col))
             stats[field] = {"min": float(col.min()),
                             "median": float(np.median(col)),
                             "max": float(col.max()),
                             "argmax_host": amax,
-                            "argmax_host_name": hosts[amax]}
+                            "argmax_host_name": hosts[amax],
+                            "argmin_host": amin,
+                            "argmin_host_name": hosts[amin]}
         verdict = self._detect_straggler(step, matrix[:, 0], hosts,
                                          steps_delta)
         if leader:
@@ -410,7 +429,8 @@ class FleetAggregator:
             "step": int(step),
             "hosts": list(hosts),
             "fields": {f: [float(v) for v in matrix[:, j]]
-                       for j, f in enumerate(FLEET_FIELDS)},
+                       for j, f in enumerate(
+                           FLEET_FIELDS[:matrix.shape[1]])},
             "stats": stats,
             "stragglers": {
                 h: {"count": c,

@@ -467,10 +467,14 @@ def build_goodput(tcfg, telemetry=None, cfg_hash: str = "",
 # Supervisor-side manifest finalisation
 # ---------------------------------------------------------------------------
 
-def classify_exit(rc: int, immediate_restart_rcs=()) -> str:
+def classify_exit(rc: int, immediate_restart_rcs=(), oom_rcs=()) -> str:
     """Human-readable restart cause from a child exit code."""
     if rc == 0:
         return "clean"
+    if rc in set(oom_rcs or ()):
+        # The memory observatory's distinct rc (telemetry/memory.py):
+        # deterministic OOM — a config bug, not a preemption.
+        return "oom"
     if rc in set(immediate_restart_rcs or ()):
         return "watchdog"
     if rc < 0 or rc in (128 + 15, 128 + 9):  # signal deaths (Popen: -sig)

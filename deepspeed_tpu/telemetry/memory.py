@@ -1,0 +1,798 @@
+"""Memory observatory — XLA attribution, capacity planning, OOM forensics.
+
+The observability stack answers every *time* question (tracer spans,
+goodput categories, fleet stragglers) but, until this module, no *memory*
+question: the engine emitted raw HBM watermarks and nothing else, so an
+OOM was a silent restart loop and every ZeRO-stage/offload/microbatch
+choice was made blind. `telemetry.memory` (docs/OBSERVABILITY.md "Memory
+observatory") adds three tiers:
+
+- **Attribution** — once per compiled step function (cached per
+  executable, re-armed by the recompile detector, like ``engine/mfu``)
+  the observatory pulls ``compiled.memory_analysis()`` and emits the
+  ``memory/xla_*_bytes`` gauges, plus a closed-form **model-state
+  ledger** computed from the TrainState pytree + its ZeRO shardings:
+  per-device bytes for master params / optimizer moments / grad
+  accumulator / compute-dtype params as a function of ZeRO stage,
+  offload tier and dtypes — the ZeRO "2+2+K" accounting made concrete
+  (params@2 + grads@2 + K=12 for fp32 Adam master+m+v, divided by the
+  shard count each stage earns). ``memory/hbm_headroom_bytes``
+  (device ``bytes_limit`` − peak, min over local devices) rides the
+  per-step HBM gauge fetch, with a ``memory/headroom_low`` trace
+  instant below ``headroom_warn_frac``.
+- **Capacity planner** — a pre-compile :func:`plan_capacity` projecting
+  per-device bytes across ZeRO stages 0–3 × offload × microbatch from
+  the same component totals, logged as a startup what-if table and
+  persisted as ``memory_plan.json``; the engine warns loudly when the
+  *chosen* config projects over HBM.
+- **OOM forensics** — the engines wrap their compile/step dispatches in
+  :meth:`MemoryObservatory.oom_guard`: a RESOURCE_EXHAUSTED escaping the
+  step writes a memory crashdump (all-device ``memory_stats``,
+  ``jax.profiler.device_memory_profile`` pprof when available, the
+  ledger, the XLA analysis, the plan, a metrics tail) in the guardrails
+  crashdump format and exits with a **distinct** rc
+  (:data:`~deepspeed_tpu.config.constants.MEMORY_OOM_EXIT_CODE_DEFAULT`)
+  that the resilience ``Supervisor`` classifies as ``cause=oom`` and
+  does **not** restart — a deterministic OOM is a config bug, not a
+  preemption, and a hot restart loop would just re-OOM until the budget
+  is gone.
+
+Zero-overhead contract (the PR 2/3/5/6 gate): ``telemetry.memory``
+defaults off and :func:`build_memory_observatory` then returns ``None``
+— the engine holds ``memory = None``, every hook is one attribute
+check, the step jaxpr is bit-identical (the observatory never touches
+the jitted step functions), and no extra device syncs or host fetches
+happen (asserted in tests/test_memory_observatory.py). Enabled, the
+only device-adjacent work is the one AOT lower+compile per step
+function (booked as ``recompile`` goodput) and the per-step
+``memory_stats`` read the HBM gauges already pay for.
+
+jax is imported lazily so the module stays importable on jax-less
+report hosts; ``tools/memory_report.py`` is stdlib-only by the same
+rule as the other report tools.
+"""
+
+import contextlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.config.constants import MEMORY_OOM_EXIT_CODE_DEFAULT
+from deepspeed_tpu.telemetry.goodput import _atomic_write_json
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+PLAN_FORMAT = 1
+LEDGER_FORMAT = 1
+
+HEADROOM_INSTANT = "memory/headroom_low"
+OOM_INSTANT = "memory/oom"
+OOM_COUNTER = "memory/oom_crashdumps"
+
+# XLA memory_analysis fields surfaced as gauges (per-device bytes of the
+# compiled step executable).
+_XLA_FIELDS = ("argument", "output", "temp", "alias", "generated_code")
+
+# Ledger components emitted as memory/ledger_<component>_bytes gauges.
+_LEDGER_COMPONENTS = ("master", "optimizer", "grads", "compute_params",
+                      "scalars", "device", "host")
+
+# Every metric tag this module can emit (gauges, the OOM counter and the
+# trace-instant names) — pinned against docs/OBSERVABILITY.md in BOTH
+# directions by tests/test_doc_lint.py, like GOODPUT/FLEET_METRIC_TAGS.
+MEMORY_METRIC_TAGS = frozenset(
+    {f"memory/xla_{f}_bytes" for f in _XLA_FIELDS}
+    | {f"memory/ledger_{c}_bytes" for c in _LEDGER_COMPONENTS}
+    | {"memory/hbm_headroom_bytes", "memory/hbm_limit_bytes",
+       HEADROOM_INSTANT, OOM_INSTANT, OOM_COUNTER})
+
+
+def is_resource_exhausted(err: BaseException) -> bool:
+    """Is this exception an XLA allocation failure? jax surfaces device
+    OOM as ``XlaRuntimeError('RESOURCE_EXHAUSTED: Out of memory
+    allocating …')`` (the class is version-dependent, so match by
+    message/status). Deliberately NARROW: the no-restart policy this
+    predicate gates is justified by determinism, so a bare
+    "out of memory" quoted inside some other error must not trip it —
+    only the XLA status code, or an XLA runtime error whose own message
+    says out-of-memory."""
+    msg = f"{err}".lower()
+    if "resource_exhausted" in msg or "resource exhausted" in msg:
+        return True
+    return ("xlaruntimeerror" in type(err).__name__.lower()
+            and "out of memory" in msg)
+
+
+def collect_memory_snapshot() -> Dict[str, Any]:
+    """All-device ``memory_stats`` + per-device headroom — the shared
+    ``memory.json`` artifact of the OOM and watchdog crashdumps. Best
+    effort: backends without stats (CPU) yield ``stats: null`` rows."""
+    devices: List[Dict[str, Any]] = []
+    headrooms: List[int] = []
+    try:
+        import jax
+        devs = jax.local_devices()
+    except Exception:  # noqa: BLE001 — backend may be gone/absent
+        devs = []
+    for d in devs:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — CPU backends may not report
+            stats = None
+        row: Dict[str, Any] = {
+            "id": getattr(d, "id", None),
+            "platform": getattr(d, "platform", None),
+            "device_kind": getattr(d, "device_kind", ""),
+            "stats": stats,
+        }
+        if stats and stats.get("bytes_limit"):
+            row["headroom_bytes"] = int(stats["bytes_limit"]
+                                        - stats.get("peak_bytes_in_use", 0))
+            headrooms.append(row["headroom_bytes"])
+        devices.append(row)
+    return {"devices": devices,
+            "min_headroom_bytes": min(headrooms) if headrooms else None}
+
+
+def min_headroom_bytes() -> Optional[int]:
+    """Tightest local device's (bytes_limit − peak), or None when no
+    device reports a limit (CPU). Used by bench.py's per-round record."""
+    return collect_memory_snapshot()["min_headroom_bytes"]
+
+
+def write_metrics_tail(out_dir: str, metrics_path: Optional[str],
+                       max_bytes: int = 64 * 1024,
+                       max_lines: int = 100) -> Optional[str]:
+    """Write the tail of a metrics JSONL into ``<out_dir>/
+    metrics_tail.jsonl`` — the shared crashdump artifact of the OOM and
+    watchdog dumps (the metric trajectory INTO the failure). Returns the
+    artifact filename, or None when there is nothing to tail."""
+    if not metrics_path or not os.path.exists(metrics_path):
+        return None
+    with open(metrics_path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        f.seek(max(0, f.tell() - max_bytes))
+        tail = f.read().decode("utf-8", errors="replace")
+    name = "metrics_tail.jsonl"
+    with open(os.path.join(out_dir, name), "w") as f:
+        f.write("\n".join(tail.splitlines()[-max_lines:]) + "\n")
+    return name
+
+
+# ---------------------------------------------------------------------------
+# Model-state ledger: per-device bytes from the TrainState + shardings
+# ---------------------------------------------------------------------------
+
+def _leaf_shard_bytes(leaf, spec, mesh_shape: Dict[str, int]) -> int:
+    """Per-device bytes of one array under a PartitionSpec — the same
+    shard arithmetic XLA uses for argument allocation (ceil per sharded
+    dim), so the ledger can be cross-checked against
+    ``memory_analysis().argument_size_in_bytes``."""
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    itemsize = int(np.dtype(getattr(leaf, "dtype", np.float32)).itemsize)
+    entries = tuple(spec) if spec is not None else ()
+    elems = 1
+    for i, d in enumerate(shape):
+        e = entries[i] if i < len(entries) else None
+        parts = e if isinstance(e, tuple) else ((e,) if e else ())
+        n = 1
+        for a in parts:
+            n *= int(mesh_shape.get(a, 1))
+        elems *= -(-int(d) // max(n, 1))
+    return elems * itemsize
+
+
+def _live_spec(leaf, fallback):
+    """The leaf's ACTUAL placement when it is a placed jax.Array (XLA's
+    output-sharding propagation may differ from the engine's spec trees
+    — e.g. ZeRO-1 keeps post-step params data-sharded, deferring the
+    all-gather into the next step's cast), else the engine spec."""
+    spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+    return spec if spec is not None else fallback
+
+
+def _tree_shard_bytes(tree, specs, mesh_shape: Dict[str, int],
+                      live: bool = True) -> int:
+    import jax
+
+    if tree is None:
+        return 0
+    if specs is None:
+        bytes_tree = jax.tree_util.tree_map(
+            lambda l: _leaf_shard_bytes(
+                l, _live_spec(l, None) if live else None, mesh_shape),
+            tree)
+    else:
+        bytes_tree = jax.tree_util.tree_map(
+            lambda l, s: _leaf_shard_bytes(
+                l, _live_spec(l, s) if live else s, mesh_shape),
+            tree, specs)
+    return int(sum(jax.tree_util.tree_leaves(bytes_tree)))
+
+
+def _tree_full_bytes(tree) -> int:
+    return _tree_shard_bytes(tree, None, {})
+
+
+def model_state_ledger(engine) -> Dict[str, Any]:
+    """Closed-form per-device model-state bytes for one engine: master
+    params / optimizer moments / grad accumulator / compute-dtype params
+    under their actual ZeRO shardings and dtypes, plus the host tiers of
+    offloaded configs. Pure host arithmetic over shapes/dtypes/specs —
+    no device work, no fetches."""
+    import jax
+
+    mesh_shape = {str(k): int(v) for k, v in dict(engine.mesh.shape).items()}
+    state = engine.state
+    offloaded = hasattr(engine, "offloader")
+    pcfg = engine._offload_param_cfg
+    ocfg = engine._offload_cfg
+
+    param_template = (engine._compute_params if offloaded else state.params)
+    total_params = int(sum(
+        int(np.prod(l.shape)) if getattr(l, "shape", ()) else 1
+        for l in jax.tree_util.tree_leaves(param_template)))
+
+    scalars = (state.step, state.micro_step, state.loss_scale,
+               state.skipped_steps, state.rng)
+    scalars_bytes = sum(_tree_full_bytes(s) for s in scalars)
+
+    per_dev = {"master_bytes": 0, "optimizer_bytes": 0, "grads_bytes": 0,
+               "compute_params_bytes": 0, "scalars_bytes": int(scalars_bytes)}
+    full = {"master_bytes": 0, "optimizer_bytes": 0, "grads_bytes": 0,
+            "compute_params_bytes": 0}
+    host = {"master_bytes": 0, "optimizer_bytes": 0, "param_tier_bytes": 0}
+
+    compute_dtype = (engine.precision.dtype if engine.precision.mixed
+                     else np.float32)
+    compute_itemsize = int(np.dtype(compute_dtype).itemsize)
+
+    if offloaded:
+        # fp32 master + moments live beside each host (sharded across
+        # hosts only through the param tier's storage specs — booked FULL
+        # per host here, the conservative bound).
+        host["master_bytes"] = (
+            _tree_full_bytes(engine.offloader.master)
+            if engine.offloader.master is not None
+            else total_params * 4)
+        host["optimizer_bytes"] = (
+            _tree_full_bytes(engine.offloader.opt_state)
+            if engine.offloader.opt_state is not None
+            else total_params * 8)
+        # Device grads: the jitted micro-scan's accumulator (ZeRO-sharded
+        # carry) is device-resident for the whole step — exactly when an
+        # OOM would fire.
+        grad_template = jax.tree_util.tree_map(
+            lambda p: np.broadcast_to(
+                np.zeros((), engine.grad_accum_dtype), p.shape),
+            param_template)
+        per_dev["grads_bytes"] = _tree_shard_bytes(
+            grad_template, engine.grad_specs, mesh_shape)
+        full["grads_bytes"] = _tree_full_bytes(grad_template)
+        if pcfg.enabled:
+            host["param_tier_bytes"] = (
+                total_params * compute_itemsize
+                // max(mesh_shape.get("data", 1), 1))
+        else:
+            compute_specs = jax.tree_util.tree_map(
+                lambda s: s.spec, engine._compute_shardings)
+            per_dev["compute_params_bytes"] = _tree_shard_bytes(
+                param_template, compute_specs, mesh_shape)
+            full["compute_params_bytes"] = total_params * compute_itemsize
+    else:
+        per_dev["master_bytes"] = _tree_shard_bytes(
+            state.params, engine.param_specs, mesh_shape)
+        full["master_bytes"] = _tree_full_bytes(state.params)
+        per_dev["optimizer_bytes"] = _tree_shard_bytes(
+            state.opt_state, engine.opt_state_specs_full, mesh_shape)
+        full["optimizer_bytes"] = _tree_full_bytes(state.opt_state)
+        per_dev["grads_bytes"] = _tree_shard_bytes(
+            state.grad_acc, engine.grad_specs, mesh_shape)
+        full["grads_bytes"] = _tree_full_bytes(state.grad_acc)
+        if engine.precision.mixed:
+            # The in-step compute-dtype cast of the params: a transient
+            # XLA allocation, but live across the whole fwd/bwd — it
+            # belongs in the model-state budget even though it is not an
+            # *argument* of the step executable. It inherits the LIVE
+            # master sharding (the cast is elementwise).
+            live_param_specs = jax.tree_util.tree_map(
+                lambda l, s: _live_spec(l, s), state.params,
+                engine.param_specs)
+            compute_template = jax.tree_util.tree_map(
+                lambda p: np.broadcast_to(
+                    np.zeros((), compute_dtype), p.shape), state.params)
+            per_dev["compute_params_bytes"] = _tree_shard_bytes(
+                compute_template, live_param_specs, mesh_shape)
+            full["compute_params_bytes"] = total_params * compute_itemsize
+
+    per_dev["model_state_bytes"] = int(sum(per_dev.values()))
+    host["total_bytes"] = int(sum(host.values()))
+    return {
+        "format": LEDGER_FORMAT,
+        "zero_stage": int(engine.config.zero_config.stage),
+        "offload_optimizer": (ocfg.device if ocfg.enabled else "none"),
+        "offload_param": (pcfg.device if pcfg.enabled else "none"),
+        "mesh": mesh_shape,
+        "dp_shard": int(mesh_shape.get("data", 1)),
+        "total_params": total_params,
+        "dtypes": {
+            "master": "float32",
+            "compute": str(np.dtype(compute_dtype)),
+            "grad_acc": str(np.dtype(engine.grad_accum_dtype)),
+        },
+        "per_device": {k: int(v) for k, v in per_dev.items()},
+        "full": {k: int(v) for k, v in full.items()},
+        "host": {k: int(v) for k, v in host.items()},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Capacity planner: ZeRO stage × offload × microbatch what-if
+# ---------------------------------------------------------------------------
+
+def plan_capacity(*, compute_params_bytes: float, grads_bytes: float,
+                  master_optim_bytes: float, num_shards: int,
+                  microbatch: int = 1, act_bytes_per_sample: float = 0.0,
+                  hbm_limit_bytes: Optional[float] = None,
+                  chosen_stage: int = 0, chosen_offload: bool = False,
+                  offload_compute_params_bytes: Optional[float] = None,
+                  total_params: int = 0) -> Dict[str, Any]:
+    """Project per-device bytes for every (ZeRO stage 0–3) × (optimizer
+    offload off/on) combination from the model's full-tree component
+    totals — the reference stage2/stage3 estimators' arithmetic
+    (runtime/zero/partition.py ``estimate_zero_model_states_mem_needs``)
+    in bytes, driven by the engine's *actual* dtypes instead of assumed
+    ones. ``act_bytes_per_sample`` × microbatch adds the activation term
+    (a user-supplied estimate; 0 projects model states only).
+
+    ``offload_compute_params_bytes``: the params term of the OFFLOAD
+    rows. A non-offload non-mixed run has no separate compute copy (the
+    fp32 master in ``mo`` IS the compute tree ⇒ compute_params_bytes
+    0), but an optimizer-offload run always materializes a
+    device-resident compute tree while the master lives host-side — so
+    its rows need the fp32 copy back. Defaults to
+    ``compute_params_bytes`` (correct for mixed precision)."""
+    n = max(int(num_shards), 1)
+    c_off = (float(offload_compute_params_bytes)
+             if offload_compute_params_bytes is not None
+             else float(compute_params_bytes))
+    rows = []
+    for stage in range(4):
+        for offload in (False, True):
+            compute = c_off if offload else float(compute_params_bytes)
+            grads, mo = float(grads_bytes), float(master_optim_bytes)
+            if stage == 0:
+                dev = compute + grads + mo
+            elif stage == 1:
+                dev = compute + grads + mo / n
+            elif stage == 2:
+                dev = compute + (grads + mo) / n
+            else:
+                dev = (compute + grads + mo) / n
+            host = 0.0
+            if offload:
+                # stage 0 has no ZeRO sharding to exploit: each host
+                # stores the FULL master+moments tier (partition.py
+                # estimator semantics); stage >= 1 stores its 1/n shard.
+                opt_shard = n if stage >= 1 else 1
+                host += mo / opt_shard
+                dev -= mo / opt_shard
+                if stage == 3:
+                    # offload_param: the compute-dtype param partition
+                    # leaves HBM too (runtime/zero/param_offload.py).
+                    host += compute / n
+                    dev -= compute / n
+            act = float(act_bytes_per_sample) * max(int(microbatch), 1)
+            total = dev + act
+            headroom = (float(hbm_limit_bytes) - total
+                        if hbm_limit_bytes else None)
+            verdict = ("unknown" if headroom is None
+                       else ("over" if headroom < 0 else "ok"))
+            rows.append({
+                "stage": stage,
+                "offload": bool(offload),
+                "model_state_bytes": int(dev),
+                "activation_bytes": int(act),
+                "device_bytes": int(total),
+                "host_bytes": int(host),
+                "headroom_bytes": (int(headroom) if headroom is not None
+                                   else None),
+                "verdict": verdict,
+                "chosen": (stage == int(chosen_stage)
+                           and bool(offload) == bool(chosen_offload)),
+            })
+    micro_proj = []
+    if act_bytes_per_sample > 0:
+        base = next(r for r in rows if r["chosen"])
+        for mult in (1, 2, 4):
+            mb = max(int(microbatch), 1) * mult
+            total = base["model_state_bytes"] + act_bytes_per_sample * mb
+            micro_proj.append({
+                "microbatch": mb,
+                "device_bytes": int(total),
+                "verdict": ("unknown" if not hbm_limit_bytes
+                            else ("over" if total > hbm_limit_bytes
+                                  else "ok")),
+            })
+    return {
+        "format": PLAN_FORMAT,
+        "total_params": int(total_params),
+        "num_shards": n,
+        "microbatch": int(microbatch),
+        "act_bytes_per_sample": float(act_bytes_per_sample),
+        "hbm_limit_bytes": (int(hbm_limit_bytes) if hbm_limit_bytes
+                            else None),
+        "rows": rows,
+        "microbatch_projection": micro_proj,
+    }
+
+
+def _gb(v) -> str:
+    return f"{v / 1024**3:8.3f}" if v is not None else "     n/a"
+
+
+def render_plan_table(plan: Dict[str, Any]) -> str:
+    """The startup what-if table (also rendered, stdlib-side, by
+    tools/memory_report.py from the persisted ``memory_plan.json``)."""
+    lines = [
+        f"memory plan: {plan['total_params'] / 1e6:.1f}M params, "
+        f"{plan['num_shards']} ZeRO shard(s), microbatch "
+        f"{plan['microbatch']}, HBM limit "
+        f"{_gb(plan['hbm_limit_bytes']).strip()} GB",
+        f"{'config':<22} {'model GB':>9} {'act GB':>8} {'device GB':>10} "
+        f"{'host GB':>8} {'headroom':>9}  verdict",
+    ]
+    lines.append("-" * len(lines[-1]))
+    for r in plan["rows"]:
+        name = (f"stage{r['stage']}"
+                + ("+offload" if r["offload"] else "")
+                + (" *" if r["chosen"] else ""))
+        lines.append(
+            f"{name:<22} {_gb(r['model_state_bytes']):>9} "
+            f"{_gb(r['activation_bytes']):>8} {_gb(r['device_bytes']):>10} "
+            f"{_gb(r['host_bytes']):>8} {_gb(r['headroom_bytes']):>9}  "
+            f"{r['verdict'].upper() if r['verdict'] == 'over' else r['verdict']}")
+    for m in plan.get("microbatch_projection", []):
+        lines.append(f"  microbatch {m['microbatch']:<4} -> device "
+                     f"{_gb(m['device_bytes']).strip()} GB  {m['verdict']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# The observatory
+# ---------------------------------------------------------------------------
+
+class MemoryObservatory:
+    """Per-engine memory observability facade (one per engine, like
+    goodput/fleet). All hooks are host-side; the only device-adjacent
+    work is the one AOT lower+compile behind :meth:`maybe_attribute`."""
+
+    def __init__(self, cfg, telemetry=None, goodput=None,
+                 run_dir: Optional[str] = None,
+                 exit_fn=os._exit):
+        self.cfg = cfg
+        self.telemetry = telemetry
+        self.goodput = goodput
+        self.run_dir = run_dir
+        self.crashdump_dir = cfg.crashdump_dir
+        self.exit_code = int(cfg.oom_exit_code)
+        self._exit_fn = exit_fn
+        self._limit_override = (int(cfg.hbm_limit_gb * 1024**3)
+                                if cfg.hbm_limit_gb else None)
+        self._xla_attempted = False
+        self._headroom_low = False
+        self.last_ledger: Optional[Dict[str, Any]] = None
+        self.last_xla: Optional[Dict[str, int]] = None
+        self.last_plan: Optional[Dict[str, Any]] = None
+
+    # -- engine init: ledger + capacity plan ----------------------------
+    def on_engine_init(self, engine) -> None:
+        try:
+            self.last_ledger = model_state_ledger(engine)
+            self._emit_ledger(self.last_ledger)
+        except Exception as e:  # noqa: BLE001 — observability must never
+            # take down the engine it observes
+            logger.warning("memory observatory: ledger failed: %s", e)
+        if self.cfg.plan_at_init:
+            try:
+                self._plan_from_engine(engine)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("memory observatory: plan failed: %s", e)
+
+    def _emit_ledger(self, ledger: Dict[str, Any]) -> None:
+        tel = self.telemetry
+        if tel is None or not getattr(tel, "enabled", False):
+            return
+        reg = tel.registry
+        per = ledger["per_device"]
+        reg.gauge("memory/ledger_master_bytes").set(per["master_bytes"])
+        reg.gauge("memory/ledger_optimizer_bytes").set(
+            per["optimizer_bytes"])
+        reg.gauge("memory/ledger_grads_bytes").set(per["grads_bytes"])
+        reg.gauge("memory/ledger_compute_params_bytes").set(
+            per["compute_params_bytes"])
+        reg.gauge("memory/ledger_scalars_bytes").set(per["scalars_bytes"])
+        reg.gauge("memory/ledger_device_bytes").set(
+            per["model_state_bytes"])
+        reg.gauge("memory/ledger_host_bytes").set(
+            ledger["host"]["total_bytes"])
+
+    def hbm_limit_bytes(self) -> Optional[int]:
+        """min ``bytes_limit`` over local devices, else the config
+        override, else None (CPU without a hint)."""
+        snap = collect_memory_snapshot()
+        limits = [d["stats"]["bytes_limit"] for d in snap["devices"]
+                  if d.get("stats") and d["stats"].get("bytes_limit")]
+        if limits:
+            return int(min(limits))
+        return self._limit_override
+
+    def _plan_from_engine(self, engine) -> None:
+        ledger = self.last_ledger or model_state_ledger(engine)
+        full = ledger["full"]
+        mo = (full["master_bytes"] + full["optimizer_bytes"]) or (
+            ledger["host"]["master_bytes"] + ledger["host"]["optimizer_bytes"])
+        # compute_params_bytes is 0 for non-mixed runs (no separate
+        # compute-dtype copy: the fp32 master in `mo` IS the compute
+        # tree) — but the OFFLOAD what-if rows always need a device
+        # compute tree (the master moves host-side), so they fall back
+        # to the fp32 master size when no mixed-precision copy exists.
+        self.last_plan = plan_capacity(
+            compute_params_bytes=full["compute_params_bytes"],
+            offload_compute_params_bytes=(
+                full["compute_params_bytes"]
+                or full["master_bytes"]
+                or ledger["host"]["master_bytes"]),
+            grads_bytes=full["grads_bytes"],
+            master_optim_bytes=mo,
+            num_shards=ledger["dp_shard"],
+            microbatch=int(engine.train_micro_batch_size_per_gpu),
+            act_bytes_per_sample=float(self.cfg.activation_bytes_per_sample),
+            hbm_limit_bytes=self.hbm_limit_bytes(),
+            chosen_stage=ledger["zero_stage"],
+            chosen_offload=ledger["offload_optimizer"] != "none",
+            total_params=ledger["total_params"])
+        log_dist("memory observatory what-if:\n"
+                 + render_plan_table(self.last_plan), ranks=[0])
+        chosen = next(r for r in self.last_plan["rows"] if r["chosen"])
+        if chosen["verdict"] == "over":
+            logger.warning(
+                "memory observatory: the CHOSEN config (stage %d%s) "
+                "projects %.2f GB per device against a %.2f GB HBM limit "
+                "— this run is expected to OOM; consult the what-if "
+                "table above for a fitting stage/offload/microbatch",
+                chosen["stage"],
+                "+offload" if chosen["offload"] else "",
+                chosen["device_bytes"] / 1024**3,
+                self.last_plan["hbm_limit_bytes"] / 1024**3)
+        if self.run_dir:
+            from deepspeed_tpu.telemetry.fleet import (
+                host_scoped_path, telemetry_host_component)
+            try:
+                _atomic_write_json(
+                    os.path.join(self.run_dir, host_scoped_path(
+                        self.cfg.plan_file, telemetry_host_component())),
+                    self.last_plan)
+            except OSError as e:
+                logger.warning("memory plan write failed: %s", e)
+
+    # -- per-executable XLA attribution ---------------------------------
+    def maybe_attribute(self, engine, batches, lr, status) -> None:
+        """Pull ``compiled.memory_analysis()`` for the engine's step
+        executable — once, re-armed when the recompile detector reports a
+        new compile/retrace (same cadence as ``engine/mfu``'s cost
+        analysis). The AOT lower+compile is booked as ``recompile``
+        goodput; the XLA compilation cache dedupes the binary."""
+        if self._xla_attempted and status not in ("compile", "retrace"):
+            return
+        self._xla_attempted = True
+        try:
+            # Refresh the ledger from the LIVE state placement first, so
+            # ledger and XLA analysis describe the same executable (the
+            # post-step placement can differ from the init-time one —
+            # see _live_spec).
+            self.last_ledger = model_state_ledger(engine)
+            self._emit_ledger(self.last_ledger)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("memory observatory: ledger refresh failed: %s",
+                           e)
+        try:
+            g = self.goodput
+            ctx = (g.measure("recompile") if g is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                if engine._train_step is not None:
+                    lowered = engine._train_step.lower(
+                        engine.state, batches, lr)
+                elif getattr(engine, "_offload_micro_scan", None) is not None:
+                    lowered = engine._offload_micro_scan.lower(
+                        engine._compute_params, engine.state.rng, batches,
+                        np.float32(1.0))
+                else:
+                    return
+                stats = lowered.compile().memory_analysis()
+            xla = {}
+            for f in _XLA_FIELDS:
+                v = getattr(stats, f"{f}_size_in_bytes", None)
+                if v is not None:
+                    xla[f"{f}_bytes"] = int(v)
+            self.last_xla = xla
+            self._emit_xla(xla, step=engine.global_steps)
+        except Exception as e:  # noqa: BLE001 — attribution is best-effort
+            logger.warning(
+                "memory observatory: XLA memory analysis unavailable: %s", e)
+
+    def _emit_xla(self, xla: Dict[str, int], step: int) -> None:
+        tel = self.telemetry
+        if tel is None or not getattr(tel, "enabled", False):
+            return
+        reg = tel.registry
+        for f in _XLA_FIELDS:
+            v = xla.get(f"{f}_bytes")
+            if v is not None:
+                reg.gauge(f"memory/xla_{f}_bytes").set(v, step=step)
+
+    # -- per-step headroom (rides the engine's HBM gauge fetch) ---------
+    def note_hbm(self, peaks: List[int], limits: List[int],
+                 step: int) -> None:
+        """Called by ``_emit_step_telemetry`` with the per-device peak /
+        ``bytes_limit`` lists it already fetched — no extra device work.
+        Emits headroom = min(limit − peak) and a ``memory/headroom_low``
+        instant when it first drops below ``headroom_warn_frac``."""
+        pairs = [(int(l), int(p)) for l, p in zip(limits, peaks)
+                 if l and l > 0]
+        if pairs:
+            headroom = min(l - p for l, p in pairs)
+            limit = min(l for l, _ in pairs)
+        elif self._limit_override is not None and peaks:
+            limit = self._limit_override
+            headroom = limit - max(int(p) for p in peaks)
+        else:
+            return
+        tel = self.telemetry
+        if tel is not None and getattr(tel, "enabled", False):
+            tel.registry.gauge("memory/hbm_headroom_bytes").set(
+                headroom, step=step)
+            tel.registry.gauge("memory/hbm_limit_bytes").set(
+                limit, step=step)
+        low = headroom < float(self.cfg.headroom_warn_frac) * limit
+        if low and not self._headroom_low:
+            logger.warning(
+                "memory observatory: HBM headroom %.2f GB is below %.0f%% "
+                "of the %.2f GB limit — the next allocation spike (longer "
+                "sequence, retrace, eval batch) may OOM",
+                headroom / 1024**3,
+                float(self.cfg.headroom_warn_frac) * 100, limit / 1024**3)
+            if tel is not None and getattr(tel, "enabled", False):
+                tel.instant(HEADROOM_INSTANT, step=step,
+                            headroom_bytes=int(headroom),
+                            limit_bytes=int(limit))
+        self._headroom_low = low
+
+    # -- OOM forensics ---------------------------------------------------
+    @contextlib.contextmanager
+    def oom_guard(self, engine, label: str = "train_step"):
+        """Wraps a compile/step dispatch: RESOURCE_EXHAUSTED → memory
+        crashdump → exit with the distinct OOM rc (``os._exit`` by
+        default — the allocator state after a device OOM is not worth
+        unwinding through; injectable for tests). Everything else
+        propagates untouched."""
+        try:
+            yield
+        except Exception as e:  # noqa: BLE001 — filtered below
+            if not is_resource_exhausted(e):
+                raise
+            try:
+                path = self.write_crashdump(engine, e, label=label)
+                logger.error(
+                    "memory observatory: RESOURCE_EXHAUSTED in %s at step "
+                    "%d — crashdump at %s; exiting rc=%d (the supervisor "
+                    "classifies cause=oom and does NOT restart: a "
+                    "deterministic OOM is a config bug — see the what-if "
+                    "table in memory_plan.json / tools/memory_report.py)",
+                    label, getattr(engine, "global_steps", -1), path,
+                    self.exit_code)
+            except Exception as dump_err:  # noqa: BLE001 — dying loudly
+                # beats dying twice
+                logger.error(
+                    "memory observatory: OOM crashdump failed: %s", dump_err)
+            self._exit_fn(self.exit_code)
+            raise  # unreachable with os._exit; reached with injected exit_fn
+
+    def write_crashdump(self, engine, err: BaseException,
+                        label: str = "train_step") -> str:
+        """The guardrails-format crashdump directory a post-mortem needs:
+        every artifact best-effort, ``info.json`` last (fsync'd)."""
+        step = int(getattr(engine, "global_steps", 0))
+        out = os.path.join(self.crashdump_dir,
+                           f"oom_step{step}_{os.getpid()}")
+        os.makedirs(out, exist_ok=True)
+        info: Dict[str, Any] = {
+            "kind": "oom", "step": step, "label": label,
+            "pid": os.getpid(), "exit_code": self.exit_code,
+            "error": str(err)[:4000],
+        }
+
+        # 1. All-device memory stats + headroom (the watchdog dump shares
+        # this artifact via collect_memory_snapshot).
+        try:
+            with open(os.path.join(out, "memory.json"), "w") as f:
+                json.dump(collect_memory_snapshot(), f, indent=1)
+            info["memory"] = "memory.json"
+        except Exception as e:  # noqa: BLE001
+            info["memory_error"] = repr(e)
+
+        # 2. The model-state ledger (recomputed if the init-time one is
+        # stale/absent; shapes/specs are host state and survive the OOM).
+        try:
+            ledger = self.last_ledger or model_state_ledger(engine)
+            with open(os.path.join(out, "ledger.json"), "w") as f:
+                json.dump(ledger, f, indent=1)
+            info["ledger"] = "ledger.json"
+        except Exception as e:  # noqa: BLE001
+            info["ledger_error"] = repr(e)
+
+        # 3. XLA memory analysis + the capacity plan, when known.
+        for name, doc in (("xla_memory_analysis.json", self.last_xla),
+                          ("plan.json", self.last_plan)):
+            if doc:
+                try:
+                    with open(os.path.join(out, name), "w") as f:
+                        json.dump(doc, f, indent=1)
+                    info[name.split(".")[0]] = name
+                except Exception as e:  # noqa: BLE001
+                    info[f"{name}_error"] = repr(e)
+
+        # 4. Device memory profile (pprof) — names the live allocations.
+        try:
+            import jax.profiler
+            prof = jax.profiler.device_memory_profile()
+            with open(os.path.join(out, "device_memory.pprof"), "wb") as f:
+                f.write(prof)
+            info["device_memory_profile"] = "device_memory.pprof"
+        except Exception as e:  # noqa: BLE001
+            info["device_memory_profile_error"] = repr(e)
+
+        # 5. Metrics tail (the headroom trajectory INTO the OOM) — the
+        # same shared artifact the watchdog dump writes.
+        tel = self.telemetry
+        try:
+            name = write_metrics_tail(out, getattr(tel, "metrics_path",
+                                                   None))
+            if name:
+                info["metrics_tail"] = name
+        except Exception as e:  # noqa: BLE001
+            info["metrics_tail_error"] = repr(e)
+
+        with open(os.path.join(out, "info.json"), "w") as f:
+            json.dump(info, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+
+        if tel is not None and getattr(tel, "enabled", False):
+            try:
+                tel.registry.counter(OOM_COUNTER).inc(step=step)
+                tel.instant(OOM_INSTANT, step=step, label=label)
+                tel.flush()
+            except Exception:  # noqa: BLE001 — never block the exit
+                pass
+        g = self.goodput
+        if g is not None:
+            # The supervisor will stamp the rc post-mortem too, but the
+            # engine knows the cause with certainty — record it now.
+            g.write_manifest(exit_rc=self.exit_code, restart_cause="oom")
+        return out
+
+
+def build_memory_observatory(tcfg, telemetry=None, goodput=None,
+                             exit_fn=os._exit) -> \
+        Optional[MemoryObservatory]:
+    """``None`` unless telemetry AND its memory block are enabled — the
+    engine's hooks gate on ``is None`` (the zero-overhead contract, same
+    shape as goodput/fleet/guardrails)."""
+    if tcfg is None or not tcfg.enabled or not tcfg.memory.enabled:
+        return None
+    return MemoryObservatory(tcfg.memory, telemetry=telemetry,
+                             goodput=goodput, run_dir=tcfg.dir,
+                             exit_fn=exit_fn)

@@ -103,13 +103,24 @@ class SynchronizedWallClockTimer:
 
     @staticmethod
     def memory_usage() -> str:
+        """ALL local devices, not just [0] (same aggregation as the
+        engine's HBM gauges): a multi-chip host's OOM margin is set by
+        its worst chip (max of peaks) and its real footprint is the sum
+        of in-use across chips."""
         try:
             import jax
 
-            stats = jax.local_devices()[0].memory_stats() or {}
-            in_use = stats.get("bytes_in_use", 0) / (1024**3)
-            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
-            return f"HBM in-use {in_use:.2f} GB | peak {peak:.2f} GB"
+            peaks, in_use = [], []
+            for dev in jax.local_devices():
+                stats = dev.memory_stats() or {}
+                if stats:
+                    peaks.append(stats.get("peak_bytes_in_use", 0))
+                    in_use.append(stats.get("bytes_in_use", 0))
+            if not peaks:
+                return "HBM stats unavailable"
+            return (f"HBM in-use {sum(in_use) / 1024**3:.2f} GB | "
+                    f"peak {max(peaks) / 1024**3:.2f} GB "
+                    f"({len(peaks)} devices)")
         except Exception:
             return "HBM stats unavailable"
 

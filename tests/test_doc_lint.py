@@ -18,6 +18,7 @@ import re
 
 from deepspeed_tpu.telemetry.fleet import FLEET_METRIC_TAGS
 from deepspeed_tpu.telemetry.goodput import GOODPUT_METRIC_TAGS
+from deepspeed_tpu.telemetry.memory import MEMORY_METRIC_TAGS
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "deepspeed_tpu")
@@ -28,6 +29,7 @@ _METRIC_CALL_RE = re.compile(
     r"\.(?:gauge|counter|histogram|_counter)\(\s*(f?)([\"'])([^\"']+)\2")
 _GOODPUT_TOKEN_RE = re.compile(r"goodput/[A-Za-z_]+")
 _FLEET_TOKEN_RE = re.compile(r"fleet/[A-Za-z_]+")
+_MEMORY_TOKEN_RE = re.compile(r"memory/[A-Za-z_]+")
 
 
 def _iter_py_files():
@@ -116,6 +118,37 @@ class TestDocDrift:
             f"emits: {phantom}")
         # the device-time attribution gauge rides the same enforcement
         assert "comm/exposed_frac" in doc
+
+    def test_memory_tags_documented_and_vice_versa(self):
+        """The memory-observatory surface (telemetry/memory.py) is
+        pinned in BOTH directions like goodput/fleet: every tag the
+        observatory can emit — the xla_*/ledger_*/headroom gauges, the
+        OOM counter and the instant names — must be in the doc, and
+        every memory/* token the doc names must be one the code
+        emits."""
+        doc = _doc_text()
+        undocumented = sorted(t for t in MEMORY_METRIC_TAGS if t not in doc)
+        assert not undocumented, undocumented
+        doc_tokens = set(_MEMORY_TOKEN_RE.findall(doc))
+        phantom = sorted(t for t in doc_tokens
+                         if t not in MEMORY_METRIC_TAGS)
+        assert not phantom, (
+            f"docs/OBSERVABILITY.md names memory tags the code never "
+            f"emits: {phantom}")
+
+    def test_memory_report_gauges_in_sync(self):
+        """tools/memory_report.py is stdlib-only by design (no package
+        import), so its private gauge lists are pinned here instead —
+        every gauge the report reads must be one the code emits."""
+        with open(os.path.join(REPO, "tools", "memory_report.py")) as f:
+            src = f.read()
+        report_tags = set(re.findall(r'"((?:memory|engine)/[A-Za-z_]+)"',
+                                     src))
+        known = MEMORY_METRIC_TAGS | {"engine/hbm_peak_bytes"}
+        phantom = sorted(t for t in report_tags if t not in known)
+        assert not phantom, (
+            f"tools/memory_report.py reads gauges the code never emits: "
+            f"{phantom} — keep it in sync with telemetry/memory.py")
 
     def test_goodput_report_categories_in_sync(self):
         """tools/goodput_report.py is stdlib-only by design (no package

@@ -26,7 +26,8 @@ from deepspeed_tpu.config.config import (ConfigError, DeepSpeedTPUConfig,
 from deepspeed_tpu.parallel.mesh import build_mesh
 from deepspeed_tpu.telemetry import (InMemorySink, MetricsRegistry,
                                      StepTracer, Telemetry)
-from deepspeed_tpu.telemetry.fleet import (FLEET_FIELDS, FleetAggregator,
+from deepspeed_tpu.telemetry.fleet import (FLEET_FIELDS, _FLEET_STATS,
+                                           FleetAggregator,
                                            _decode_host, _encode_host,
                                            all_gather_rows,
                                            host_scoped_path,
@@ -119,8 +120,11 @@ class TestAggregator:
     HOSTS = ["hostA", "hostB", "hostC"]
 
     def _matrix(self, step_times, stall=0.1, hbm=1000.0, prod=1.0,
-                exposed=0.05):
-        return np.array([[st, stall, hbm * (i + 1), prod, exposed]
+                exposed=0.05, headroom=500.0):
+        # headroom decreases with host index: the LAST host is the
+        # tightest (argmin names it).
+        return np.array([[st, stall, hbm * (i + 1), prod, exposed,
+                          headroom / (i + 1)]
                          for i, st in enumerate(step_times)], np.float32)
 
     def test_stats_and_argmax_emitted(self, tmp_path):
@@ -131,10 +135,12 @@ class TestAggregator:
         assert mem.values("fleet/step_time_sec_max")[-1] == 2.0
         assert mem.values("fleet/step_time_sec_argmax_host")[-1] == 1
         assert mem.values("fleet/hbm_peak_bytes_argmax_host")[-1] == 2
+        # the tightest-headroom host is NAMED by argmin (host index 2)
+        assert mem.values("fleet/hbm_headroom_bytes_argmin_host")[-1] == 2
         assert mem.values("fleet/hosts")[-1] == 3
-        # every field emits its four stats
+        # every field emits its five stats
         for f in FLEET_FIELDS:
-            for s in ("min", "median", "max", "argmax_host"):
+            for s in _FLEET_STATS:
                 assert mem.values(f"fleet/{f}_{s}"), (f, s)
 
     def _build(self, tmp_path, **kw):
@@ -214,8 +220,9 @@ class TestEngineFleet:
         assert engine.fleet is not None
         mem = engine.telemetry.registry.sinks[0]
         fleet_tags = {t for t in mem.tags() if t.startswith("fleet/")}
-        # 5 fields x 4 stats + fleet/hosts
-        assert len(fleet_tags) == len(FLEET_FIELDS) * 4 + 1, fleet_tags
+        # 6 fields x 5 stats + fleet/hosts
+        assert len(fleet_tags) == \
+            len(FLEET_FIELDS) * len(_FLEET_STATS) + 1, fleet_tags
         assert mem.values("fleet/hosts")[-1] == 1
         assert mem.values("fleet/step_time_sec_max")[-1] > 0
         doc = json.load(open(tmp_path / "fleet_breakdown.json"))

@@ -1,0 +1,721 @@
+"""Memory observatory tests (telemetry/memory.py; docs/OBSERVABILITY.md
+"Memory observatory"): the model-state ledger cross-checked against
+``compiled.memory_analysis()`` across ZeRO stages 0-3 (MLP + the test
+GPT config) and the offload tier, capacity-planner over/under-HBM
+verdicts, simulated RESOURCE_EXHAUSTED -> crashdump + supervisor
+``cause=oom`` (unit and child-process e2e, asserting NO restart), the
+zero-overhead disabled contract (attribute None, zero device syncs,
+bit-identical step jaxpr — the fleet/goodput contract shape), per-step
+headroom gauges + low-headroom instant, the all-device
+``see_memory_usage``/timer satellites, the watchdog ``memory.json``
+artifact, the fleet headroom field, and tools/memory_report.py."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config.config import ConfigError, DeepSpeedTPUConfig
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.telemetry.goodput import classify_exit
+from deepspeed_tpu.telemetry.memory import (MEMORY_METRIC_TAGS,
+                                            collect_memory_snapshot,
+                                            is_resource_exhausted,
+                                            model_state_ledger,
+                                            plan_capacity,
+                                            render_plan_table)
+
+from simple_model import mlp_loss_fn, mlp_params, random_batches
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+OOM_MSG = "RESOURCE_EXHAUSTED: Out of memory allocating 2147483648 bytes"
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tel_cfg(tmp_path, memory=None, sinks=("memory",), trace=False):
+    tel = {"enabled": True, "dir": str(tmp_path),
+           "trace": {"enabled": trace},
+           "metrics": {"sinks": list(sinks)}}
+    if memory is not None:
+        tel["memory"] = memory
+    return {"telemetry": tel, "steps_per_print": 1}
+
+
+def _engine(config_extra=None, mesh=None):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn, params=mlp_params(),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                **(config_extra or {})},
+        mesh=mesh if mesh is not None else build_mesh(data=8))
+    return engine
+
+
+def _batch_bytes_per_device(batches, n_dev=8):
+    """Per-device bytes of a (gas-leading) batch whose dim-1 divides the
+    data axis — the term the XLA argument cross-check adds on top of the
+    ledger's model state."""
+    return sum(np.asarray(v).nbytes for v in
+               jax.tree_util.tree_leaves(batches)) // n_dev
+
+
+def _ledger_args_bytes(ledger):
+    """The ledger components that are ARGUMENTS of the step executable
+    (the compute-dtype cast is an in-program temp, not an argument)."""
+    per = ledger["per_device"]
+    return (per["master_bytes"] + per["optimizer_bytes"]
+            + per["grads_bytes"] + per["scalars_bytes"])
+
+
+def _crosscheck(engine, batches, n_dev=8, rtol=0.02):
+    """The acceptance gate: ledger-predicted argument bytes must match
+    compiled.memory_analysis() within the stated tolerance (2%)."""
+    xla = engine.memory.last_xla
+    assert xla is not None and xla["argument_bytes"] > 0
+    expected = (_ledger_args_bytes(engine.memory.last_ledger)
+                + _batch_bytes_per_device(batches, n_dev)
+                + 4)                                    # the lr scalar
+    assert abs(xla["argument_bytes"] - expected) <= max(
+        512, rtol * xla["argument_bytes"]), (
+        f"ledger {expected} vs xla {xla['argument_bytes']} "
+        f"(ledger={engine.memory.last_ledger})")
+
+
+# ---------------------------------------------------------------------------
+# Ledger vs compiled.memory_analysis() — ZeRO stages 0-3 + offload
+# ---------------------------------------------------------------------------
+class TestLedgerCrossCheck:
+    @pytest.mark.parametrize("stage", [0, 1, 2, 3])
+    def test_mlp_stage_sweep(self, eight_devices, tmp_path, stage):
+        zero = {"stage": stage}
+        if stage == 3:
+            # The tiny MLP sits below the stage-3 persistence threshold —
+            # lower it so the sweep exercises real param sharding.
+            zero["stage3_param_persistence_threshold"] = 0
+        engine = _engine({**_tel_cfg(tmp_path, memory={"enabled": True}),
+                          "zero_optimization": zero})
+        batches = random_batches(np.random.default_rng(0), gas=1,
+                                 batch_size=16)
+        engine.train_batch(batches)
+        ledger = engine.memory.last_ledger
+        assert ledger["zero_stage"] == stage
+        assert ledger["per_device"]["master_bytes"] > 0
+        if stage >= 1:
+            # sharding must actually shrink the per-device moments
+            assert (ledger["per_device"]["optimizer_bytes"]
+                    < ledger["full"]["optimizer_bytes"])
+        _crosscheck(engine, batches)
+        # the ledger gauges landed in the sink
+        mem = engine.telemetry.registry.sinks[0]
+        for tag in ("memory/ledger_master_bytes",
+                    "memory/ledger_optimizer_bytes",
+                    "memory/ledger_grads_bytes",
+                    "memory/ledger_device_bytes"):
+            assert mem.values(tag), tag
+        for f in ("argument", "temp", "output", "alias"):
+            assert mem.values(f"memory/xla_{f}_bytes"), f
+
+    @pytest.mark.parametrize("stage", [0, 1, 2, 3])
+    def test_gpt_stage_sweep(self, eight_devices, tmp_path, stage):
+        """The acceptance config: the in-tree test GPT across every ZeRO
+        stage, ledger vs XLA within the stated 2%."""
+        from deepspeed_tpu.models import make_gpt
+        model, cfg = make_gpt("tiny", num_layers=2, dropout_rate=0.0,
+                              dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (8, 16), dtype=np.int32)
+        params = model.init({"params": jax.random.PRNGKey(0),
+                             "dropout": jax.random.PRNGKey(1)},
+                            {"input_ids": ids})["params"]
+        zero = {"stage": stage}
+        if stage == 3:
+            zero["stage3_param_persistence_threshold"] = 0
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, params=params, mesh=build_mesh(data=8),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": zero,
+                    **_tel_cfg(tmp_path, memory={"enabled": True})})
+        batches = {"input_ids": ids[None]}
+        engine.train_batch(batches)
+        _crosscheck(engine, batches)
+
+    def test_mixed_precision_counts_compute_copy(self, eight_devices,
+                                                 tmp_path):
+        """bf16: the in-step compute cast is live model state (counted in
+        the ledger) but NOT a program argument (excluded from the
+        cross-check) — both facts asserted."""
+        engine = _engine({**_tel_cfg(tmp_path, memory={"enabled": True}),
+                          "zero_optimization": {"stage": 2},
+                          "bf16": {"enabled": True}})
+        batches = random_batches(np.random.default_rng(0), gas=1,
+                                 batch_size=16)
+        engine.train_batch(batches)
+        ledger = engine.memory.last_ledger
+        per = ledger["per_device"]
+        assert per["compute_params_bytes"] > 0
+        # bf16 copy is half the fp32 master
+        assert per["compute_params_bytes"] == per["master_bytes"] // 2
+        _crosscheck(engine, batches)
+
+    def test_offload_ledger_host_tiers(self, eight_devices, tmp_path):
+        engine = _engine({
+            **_tel_cfg(tmp_path, memory={"enabled": True}),
+            "zero_optimization": {
+                "stage": 2, "offload_optimizer": {"device": "cpu"}}})
+        batches = random_batches(np.random.default_rng(0), gas=1,
+                                 batch_size=16)
+        engine.train_batch(batches)
+        ledger = engine.memory.last_ledger
+        assert ledger["offload_optimizer"] == "cpu"
+        # master + moments live host-side; device keeps the grads scan
+        # accumulator (ZeRO-sharded) + compute params
+        assert ledger["per_device"]["master_bytes"] == 0
+        assert ledger["host"]["master_bytes"] > 0
+        assert ledger["host"]["optimizer_bytes"] > 0
+        assert ledger["per_device"]["grads_bytes"] > 0
+        assert ledger["per_device"]["compute_params_bytes"] > 0
+        # the offload tier attributes its device-side micro-scan
+        assert engine.memory.last_xla is not None
+        assert engine.memory.last_xla["argument_bytes"] > 0
+        mem = engine.telemetry.registry.sinks[0]
+        assert mem.values("memory/ledger_host_bytes")[-1] > 0
+
+
+# ---------------------------------------------------------------------------
+# Capacity planner
+# ---------------------------------------------------------------------------
+class TestPlanner:
+    GB = 1024**3
+
+    def test_stage_arithmetic_and_verdicts(self):
+        plan = plan_capacity(
+            compute_params_bytes=2 * self.GB, grads_bytes=2 * self.GB,
+            master_optim_bytes=12 * self.GB, num_shards=8,
+            hbm_limit_bytes=8 * self.GB, chosen_stage=0,
+            total_params=int(1e9))
+        rows = {(r["stage"], r["offload"]): r for r in plan["rows"]}
+        assert rows[(0, False)]["model_state_bytes"] == 16 * self.GB
+        assert rows[(0, False)]["verdict"] == "over"
+        assert rows[(0, False)]["chosen"]
+        assert rows[(1, False)]["model_state_bytes"] == int(5.5 * self.GB)
+        assert rows[(2, False)]["model_state_bytes"] == int(
+            (2 + 14 / 8) * self.GB)
+        assert rows[(3, False)]["model_state_bytes"] == 2 * self.GB
+        assert rows[(3, False)]["verdict"] == "ok"
+        # offload moves master+moments (and at stage 3 the params) host-side
+        assert rows[(0, True)]["host_bytes"] == 12 * self.GB   # unsharded
+        assert rows[(2, True)]["host_bytes"] == int(1.5 * self.GB)
+        assert rows[(3, True)]["model_state_bytes"] == int(0.25 * self.GB)
+        assert rows[(3, True)]["host_bytes"] == int(1.75 * self.GB)
+        text = render_plan_table(plan)
+        assert "OVER" in text and "stage0 *" in text
+
+    def test_offload_rows_keep_fp32_compute_copy(self):
+        """Review fix: a pure-fp32 run has compute_params_bytes 0 (the
+        master IS the compute tree), but the offload what-if rows must
+        put the fp32 copy back on device — optimizer offload moves the
+        master host-side and materializes device compute params."""
+        plan = plan_capacity(
+            compute_params_bytes=0,
+            offload_compute_params_bytes=4 * self.GB,
+            grads_bytes=4 * self.GB, master_optim_bytes=12 * self.GB,
+            num_shards=8, chosen_stage=1)
+        rows = {(r["stage"], r["offload"]): r for r in plan["rows"]}
+        # non-offload stage1: 0 + 4 + 12/8 = 5.5 GB
+        assert rows[(1, False)]["model_state_bytes"] == int(5.5 * self.GB)
+        # stage1+offload: the 4 GB fp32 copy + grads; mo host-side
+        assert rows[(1, True)]["model_state_bytes"] == 8 * self.GB
+        assert rows[(1, True)]["host_bytes"] == int(1.5 * self.GB)
+        # stage3+offload: (4+4+12)/8 − 12/8 − 4/8 = 0.5 GB on device
+        assert rows[(3, True)]["model_state_bytes"] == int(0.5 * self.GB)
+        assert rows[(3, True)]["host_bytes"] == 2 * self.GB
+
+    def test_microbatch_projection(self):
+        plan = plan_capacity(
+            compute_params_bytes=self.GB, grads_bytes=self.GB,
+            master_optim_bytes=self.GB, num_shards=1, microbatch=4,
+            act_bytes_per_sample=0.5 * self.GB,
+            hbm_limit_bytes=6 * self.GB, chosen_stage=0)
+        proj = {m["microbatch"]: m for m in plan["microbatch_projection"]}
+        assert proj[4]["verdict"] == "ok"       # 3 + 2 = 5 GB
+        assert proj[8]["verdict"] == "over"     # 3 + 4 = 7 GB
+        assert proj[16]["verdict"] == "over"
+
+    def test_engine_warns_when_chosen_config_over_hbm(
+            self, eight_devices, tmp_path, monkeypatch):
+        """The loud pre-compile warning: a config whose projection
+        exceeds the (overridden) HBM limit."""
+        from deepspeed_tpu.telemetry import memory as memory_mod
+        warnings = []
+        monkeypatch.setattr(memory_mod.logger, "warning",
+                            lambda msg, *a: warnings.append(msg))
+        engine = _engine(_tel_cfg(tmp_path, memory={
+            "enabled": True, "hbm_limit_gb": 1e-6}))
+        assert any("projects" in m and "OOM" in m for m in warnings)
+        chosen = [r for r in engine.memory.last_plan["rows"]
+                  if r["chosen"]]
+        assert chosen[0]["verdict"] == "over"
+        # the plan is persisted for memory_report
+        doc = json.load(open(tmp_path / "memory_plan.json"))
+        assert doc["rows"] and doc["hbm_limit_bytes"] > 0
+
+    def test_fitting_config_no_warning(self, eight_devices, tmp_path,
+                                       monkeypatch):
+        from deepspeed_tpu.telemetry import memory as memory_mod
+        warnings = []
+        monkeypatch.setattr(memory_mod.logger, "warning",
+                            lambda msg, *a: warnings.append(msg))
+        engine = _engine(_tel_cfg(tmp_path, memory={
+            "enabled": True, "hbm_limit_gb": 64.0}))
+        chosen = [r for r in engine.memory.last_plan["rows"]
+                  if r["chosen"]]
+        assert chosen[0]["verdict"] == "ok"
+        assert not any("expected to OOM" in m for m in warnings)
+
+
+# ---------------------------------------------------------------------------
+# Per-step headroom
+# ---------------------------------------------------------------------------
+class TestHeadroom:
+    def test_note_hbm_gauges_and_low_instant(self, eight_devices,
+                                             tmp_path):
+        engine = _engine(_tel_cfg(tmp_path, trace=True,
+                                  memory={"enabled": True,
+                                          "headroom_warn_frac": 0.1}))
+        gb = 1024**3
+        engine.memory.note_hbm([2 * gb], [10 * gb], step=1)
+        mem = engine.telemetry.registry.sinks[0]
+        assert mem.values("memory/hbm_headroom_bytes")[-1] == 8 * gb
+        assert mem.values("memory/hbm_limit_bytes")[-1] == 10 * gb
+        instants = [e for e in engine.telemetry.tracer.events
+                    if e.get("ph") == "i"
+                    and e["name"] == "memory/headroom_low"]
+        assert not instants
+        # drop below 10% of the limit -> instant fires once
+        engine.memory.note_hbm([int(9.5 * gb)], [10 * gb], step=2)
+        engine.memory.note_hbm([int(9.6 * gb)], [10 * gb], step=3)
+        instants = [e for e in engine.telemetry.tracer.events
+                    if e.get("ph") == "i"
+                    and e["name"] == "memory/headroom_low"]
+        assert len(instants) == 1
+        assert instants[0]["args"]["headroom_bytes"] == int(0.5 * gb)
+
+    def test_step_path_emits_headroom_with_device_stats(
+            self, eight_devices, tmp_path, monkeypatch):
+        """CPU devices report no memory_stats; fake them to drive the
+        real _emit_step_telemetry -> note_hbm wiring, and check the
+        fleet vector picks the gauge up."""
+        engine = _engine(_tel_cfg(tmp_path, memory={"enabled": True}))
+        gb = 1024**3
+        fake = [SimpleNamespace(memory_stats=lambda: {
+            "peak_bytes_in_use": 3 * gb, "bytes_in_use": 2 * gb,
+            "bytes_limit": 16 * gb})]
+        monkeypatch.setattr(jax, "local_devices", lambda: fake)
+        batches = random_batches(np.random.default_rng(0), gas=1,
+                                 batch_size=16)
+        engine.train_batch(batches)
+        mem = engine.telemetry.registry.sinks[0]
+        assert mem.values("memory/hbm_headroom_bytes")[-1] == 13 * gb
+        assert mem.values("engine/hbm_peak_bytes")[-1] == 3 * gb
+
+    def test_fleet_vector_carries_headroom(self, eight_devices, tmp_path):
+        """The fleet satellite: memory observatory headroom feeds the
+        fleet gather, and argmin names the tightest host."""
+        engine = _engine({**_tel_cfg(tmp_path, memory={"enabled": True}),
+                          "telemetry": {
+                              **_tel_cfg(tmp_path)["telemetry"],
+                              "memory": {"enabled": True},
+                              "fleet": {"enabled": True,
+                                        "min_window": 1}}})
+        gb = 1024**3
+        engine.memory.note_hbm([2 * gb], [10 * gb], step=0)
+        batches = random_batches(np.random.default_rng(0), gas=1,
+                                 batch_size=16)
+        for _ in range(2):
+            engine.train_batch(batches)
+            engine.memory.note_hbm([2 * gb], [10 * gb],
+                                   step=engine.global_steps)
+        mem = engine.telemetry.registry.sinks[0]
+        assert mem.values("fleet/hbm_headroom_bytes_min")[-1] == 8 * gb
+        assert mem.values("fleet/hbm_headroom_bytes_argmin_host")[-1] == 0
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+class TestOOMForensics:
+    def _oom_engine(self, tmp_path, dumps):
+        return _engine(_tel_cfg(tmp_path, sinks=("memory", "jsonl"),
+                                memory={"enabled": True,
+                                        "crashdump_dir": str(dumps)}))
+
+    def test_is_resource_exhausted(self):
+        assert is_resource_exhausted(RuntimeError(OOM_MSG))
+
+        class XlaRuntimeError(Exception):
+            pass
+
+        assert is_resource_exhausted(
+            XlaRuntimeError("Out of memory allocating 99 bytes"))
+        # NARROW by design (review fix): a bare "out of memory" quoted in
+        # some unrelated error must not trip the no-restart policy — only
+        # the XLA status code / an XLA runtime error does.
+        assert not is_resource_exhausted(
+            RuntimeError("worker log said: out of memory"))
+        assert not is_resource_exhausted(ValueError("shape mismatch"))
+
+    def test_oom_writes_crashdump_and_exits_distinct_rc(
+            self, eight_devices, tmp_path):
+        dumps = tmp_path / "dumps"
+        engine = self._oom_engine(tmp_path, dumps)
+        batches = random_batches(np.random.default_rng(0), gas=1,
+                                 batch_size=16)
+        engine.train_batch(batches)          # prime ledger + attribution
+
+        def boom(*a, **k):
+            raise RuntimeError(OOM_MSG)
+
+        engine._train_step = boom
+        rcs = []
+        engine.memory._exit_fn = rcs.append
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            engine.train_batch(batches)
+        assert rcs == [114]
+        dump_dirs = [d for d in os.listdir(dumps)
+                     if d.startswith("oom_step")]
+        assert len(dump_dirs) == 1
+        out = dumps / dump_dirs[0]
+        info = json.load(open(out / "info.json"))
+        assert info["kind"] == "oom" and info["exit_code"] == 114
+        assert "RESOURCE_EXHAUSTED" in info["error"]
+        assert info["label"] == "train_step"
+        # the forensic artifacts
+        mem_doc = json.load(open(out / "memory.json"))
+        assert "devices" in mem_doc
+        ledger = json.load(open(out / "ledger.json"))
+        assert ledger["per_device"]["model_state_bytes"] > 0
+        xla = json.load(open(out / "xla_memory_analysis.json"))
+        assert xla["argument_bytes"] > 0
+        plan = json.load(open(out / "plan.json"))
+        assert plan["rows"]
+        assert os.path.exists(out / "metrics_tail.jsonl")
+        # telemetry counter + the engine-stamped manifest cause
+        mem = engine.telemetry.registry.sinks[0]
+        assert mem.values("memory/oom_crashdumps")[-1] == 1
+        doc = json.load(open(engine.goodput.manifest_path()))
+        assert doc["restart_cause"] == "oom"
+        assert doc["exit_rc"] == 114
+
+    def test_non_oom_errors_propagate_untouched(self, eight_devices,
+                                                tmp_path):
+        dumps = tmp_path / "dumps"
+        engine = self._oom_engine(tmp_path, dumps)
+
+        def boom(*a, **k):
+            raise ValueError("shape mismatch")
+
+        engine._train_step = boom
+        rcs = []
+        engine.memory._exit_fn = rcs.append
+        with pytest.raises(ValueError, match="shape mismatch"):
+            engine.train_batch(random_batches(np.random.default_rng(0),
+                                              gas=1, batch_size=16))
+        assert rcs == []
+        assert not os.path.exists(dumps)
+
+    def test_classify_exit_oom(self):
+        assert classify_exit(114, (113,), (114,)) == "oom"
+        assert classify_exit(113, (113,), (114,)) == "watchdog"
+        assert classify_exit(-15, (113,), (114,)) == "preemption"
+        assert classify_exit(1, (113,), (114,)) == "crash"
+        assert classify_exit(0, (113,), (114,)) == "clean"
+
+    def test_oom_rc_must_differ_from_watchdog_rc(self):
+        with pytest.raises(ConfigError, match="collides"):
+            DeepSpeedTPUConfig({
+                "train_micro_batch_size_per_gpu": 1,
+                "telemetry": {"enabled": True, "dir": "/tmp/x",
+                              "memory": {"enabled": True,
+                                         "oom_exit_code": 113}},
+                "guardrails": {"enabled": True,
+                               "watchdog": {"enabled": True}}},
+                world_size=1)
+
+    def test_supervisor_does_not_restart_oom(self, tmp_path):
+        """A child exiting with the OOM rc must NOT be restarted — one
+        attempt, cause=oom stamped, loop over with the rc."""
+        from deepspeed_tpu.resilience.supervisor import Supervisor
+        sup = Supervisor([sys.executable, "-c", "import sys; sys.exit(114)"],
+                         max_restarts=3, run_dir=str(tmp_path))
+        rc = sup.run()
+        assert rc == 114
+        assert sup.exit_codes == [114]       # exactly one attempt
+        assert sup.restarts == 0 and sup.oom_exits == 1
+        manifests = [f for f in os.listdir(tmp_path)
+                     if f.startswith("run_manifest.a0000.")]
+        assert manifests
+        doc = json.load(open(tmp_path / manifests[0]))
+        assert doc["restart_cause"] == "oom"
+        assert doc["exit_rc"] == 114
+
+    def test_watchdog_rc_still_hot_restarts(self, tmp_path):
+        """The distinct-rc contract the OOM path must not break: the
+        watchdog rc keeps its immediate-restart semantics."""
+        from deepspeed_tpu.resilience.supervisor import Supervisor
+        sup = Supervisor([sys.executable, "-c", "import sys; sys.exit(113)"],
+                         max_restarts=1, run_dir=str(tmp_path))
+        rc = sup.run()
+        assert rc == 113
+        assert sup.exit_codes == [113, 113]  # restarted once, immediately
+        assert sup.immediate_restarts >= 1 and sup.oom_exits == 0
+
+    def test_e2e_child_oom_to_supervisor(self, eight_devices, tmp_path):
+        """The acceptance e2e: a REAL child process whose step raises
+        RESOURCE_EXHAUSTED -> memory crashdump on disk -> os._exit(114)
+        -> supervisor classifies cause=oom and does not hot-loop."""
+        from deepspeed_tpu.resilience.supervisor import Supervisor
+        run = tmp_path / "run"
+        dumps = tmp_path / "dumps"
+        child = f"""
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, {os.path.join(REPO, 'tests')!r})
+import numpy as np
+import deepspeed_tpu
+from deepspeed_tpu.parallel.mesh import build_mesh
+from simple_model import mlp_loss_fn, mlp_params, random_batches
+engine, _, _, _ = deepspeed_tpu.initialize(
+    loss_fn=mlp_loss_fn, params=mlp_params(),
+    config={{"train_micro_batch_size_per_gpu": 2,
+             "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+             "telemetry": {{"enabled": True, "dir": {str(run)!r},
+                            "trace": {{"enabled": False}},
+                            "metrics": {{"sinks": ["jsonl"]}},
+                            "memory": {{"enabled": True,
+                                        "crashdump_dir": {str(dumps)!r}}}}},
+             "steps_per_print": 1}},
+    mesh=build_mesh(data=8))
+batches = random_batches(np.random.default_rng(0), gas=1, batch_size=16)
+engine.train_batch(batches)
+def boom(*a, **k):
+    raise RuntimeError({OOM_MSG!r})
+engine._train_step = boom
+engine.train_batch(batches)   # -> oom_guard -> crashdump -> os._exit(114)
+raise SystemExit(99)          # must be unreachable
+"""
+        sup = Supervisor([sys.executable, "-c", child], max_restarts=3,
+                         run_dir=str(run))
+        rc = sup.run()
+        assert rc == 114
+        assert sup.exit_codes == [114]       # no restart loop
+        dump_dirs = [d for d in os.listdir(dumps)
+                     if d.startswith("oom_step")]
+        assert len(dump_dirs) == 1
+        info = json.load(open(dumps / dump_dirs[0] / "info.json"))
+        assert "RESOURCE_EXHAUSTED" in info["error"]
+        manifests = [f for f in os.listdir(run)
+                     if f.startswith("run_manifest.a0000.")]
+        assert manifests
+        doc = json.load(open(run / manifests[0]))
+        assert doc["restart_cause"] == "oom" and doc["exit_rc"] == 114
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead disabled contract (the fleet/goodput contract shape)
+# ---------------------------------------------------------------------------
+class TestDisabledContract:
+    def test_disabled_memory_is_none_no_tags_zero_syncs(
+            self, eight_devices, tmp_path, monkeypatch):
+        engine = _engine(_tel_cfg(tmp_path))      # telemetry on, memory off
+        assert engine.memory is None
+        batches = random_batches(np.random.default_rng(0), gas=1,
+                                 batch_size=16)
+        engine.train_batch(batches)               # compile outside window
+        from deepspeed_tpu.utils import timer as timer_mod
+        calls = {"n": 0}
+        monkeypatch.setattr(timer_mod, "_device_synchronize",
+                            lambda: calls.__setitem__("n", calls["n"] + 1))
+        for _ in range(10):
+            engine.train_batch(batches)
+        assert calls["n"] == 0
+        mem = engine.telemetry.registry.sinks[0]
+        assert not {t for t in mem.tags() if t.startswith("memory/")}
+        assert not os.path.exists(tmp_path / "memory_plan.json")
+        # telemetry fully off too
+        engine2 = _engine()
+        assert engine2.memory is None
+
+    def test_step_jaxpr_bit_identical(self, eight_devices, tmp_path):
+        """Enabling the observatory must not change the compiled step
+        program AT ALL — it only reads host-side state. Compare the
+        lowered step text with memory off vs on."""
+        batches_np = random_batches(np.random.default_rng(0), gas=1,
+                                    batch_size=16)
+        texts = []
+        for memory in (None, {"enabled": True}):
+            engine = _engine(_tel_cfg(tmp_path / str(bool(memory)),
+                                      memory=memory))
+            placed = engine.put_batch(batches_np, leading_gas_dim=True)
+            lowered = engine._train_step.lower(engine.state, placed,
+                                               jnp.float32(1e-2))
+            texts.append(lowered.as_text())
+        assert texts[0] == texts[1]
+
+
+# ---------------------------------------------------------------------------
+# Satellites: all-device memory reporting, watchdog artifact, report tool
+# ---------------------------------------------------------------------------
+class TestMemoryUsageSatellites:
+    def _fake_devices(self):
+        gb = 1024**3
+        mk = lambda peak, use, limit: SimpleNamespace(  # noqa: E731
+            memory_stats=lambda: {"peak_bytes_in_use": peak,
+                                  "bytes_in_use": use,
+                                  "bytes_limit": limit},
+            id=0, platform="tpu", device_kind="fake")
+        return [mk(10 * gb, 5 * gb, 32 * gb), mk(20 * gb, 6 * gb, 30 * gb)]
+
+    def test_see_memory_usage_aggregates_all_devices(self, monkeypatch):
+        from deepspeed_tpu.runtime import utils as rutils
+        monkeypatch.setattr(jax, "local_devices",
+                            lambda: self._fake_devices())
+        lines = []
+        monkeypatch.setattr(rutils.logger, "info",
+                            lambda msg, *a: lines.append(msg))
+        rutils.see_memory_usage("probe", force=True)
+        joined = "\n".join(lines)
+        # peak = MAX over devices (20), in-use = SUM (11), limit = MIN (30)
+        assert "peak 20.00 GB" in joined
+        assert "in-use 11.00 GB" in joined
+        assert "limit 30.00 GB" in joined
+        assert "2 devices" in joined
+
+    def test_timer_memory_usage_aggregates_all_devices(self, monkeypatch):
+        from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+        monkeypatch.setattr(jax, "local_devices",
+                            lambda: self._fake_devices())
+        s = SynchronizedWallClockTimer.memory_usage()
+        assert "in-use 11.00 GB" in s
+        assert "peak 20.00 GB" in s
+        assert "(2 devices)" in s
+
+    def test_collect_memory_snapshot_headroom(self, monkeypatch):
+        monkeypatch.setattr(jax, "local_devices",
+                            lambda: self._fake_devices())
+        snap = collect_memory_snapshot()
+        gb = 1024**3
+        assert len(snap["devices"]) == 2
+        # tightest device: 30 - 20 = 10 GB
+        assert snap["min_headroom_bytes"] == 10 * gb
+
+    def test_watchdog_dump_gains_memory_json(self, tmp_path, monkeypatch):
+        """The hung-collective post-mortem satellite: the watchdog
+        crashdump now answers "was the hang memory pressure?"."""
+        from deepspeed_tpu.guardrails.watchdog import StepWatchdog
+        from deepspeed_tpu.telemetry import memory as memory_mod
+        gb = 1024**3
+        monkeypatch.setattr(
+            memory_mod, "collect_memory_snapshot",
+            lambda: {"devices": [{"id": 0, "stats": {"bytes_limit": 16 * gb},
+                                  "headroom_bytes": 2 * gb}],
+                     "min_headroom_bytes": 2 * gb})
+        wd = StepWatchdog(timeout=100.0, crashdump_dir=str(tmp_path),
+                          exit_fn=lambda rc: None)
+        out = wd.dump_diagnostics(step=5, elapsed=120.0, label="train_step")
+        info = json.load(open(os.path.join(out, "info.json")))
+        assert info["memory"] == "memory.json"
+        doc = json.load(open(os.path.join(out, "memory.json")))
+        assert doc["min_headroom_bytes"] == 2 * gb
+
+    def test_bench_records_headroom_per_section(self, tmp_path,
+                                                monkeypatch):
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(REPO, "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        sys.modules["bench"] = bench
+        spec.loader.exec_module(bench)
+        monkeypatch.setattr(bench, "PARTIAL_PATH",
+                            str(tmp_path / "partial.json"))
+        result = {}
+        assert bench.run_section("s1", lambda: None, result)
+        # CPU devices report no limit -> honest None, but the key exists
+        assert "peak_headroom_bytes" in result
+        assert result["peak_headroom_bytes"]["s1"] is None
+
+
+class TestMemoryReport:
+    def test_selftest_cli(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "memory_report.py"),
+             "--selftest"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "selftest ok" in proc.stdout
+
+    def test_merges_engine_written_run_dir(self, eight_devices, tmp_path):
+        """A real engine run (memory on, jsonl sink) parses into a
+        report with the ledger/XLA columns and the persisted plan."""
+        dumps = tmp_path / "crashdumps"
+        engine = _engine(_tel_cfg(
+            tmp_path, sinks=("jsonl",),
+            memory={"enabled": True, "crashdump_dir": str(dumps)}))
+        batches = random_batches(np.random.default_rng(0), gas=1,
+                                 batch_size=16)
+        for _ in range(2):
+            engine.train_batch(batches)
+        engine.telemetry.flush()
+        mr = _load_tool("memory_report")
+        report = mr.merge_memory(str(tmp_path))
+        assert report["n_hosts"] == 1
+        row = report["hosts"][0]
+        assert row["ledger_device_bytes"] > 0
+        assert row["xla_argument_bytes"] > 0
+        assert "local" in report["plans"]
+        text = mr.render(report)
+        assert "memory report" in text and "capacity plan" in text
+
+    def test_doc_pins_every_tag(self):
+        """Belt-and-braces beside test_doc_lint: the full emitted set."""
+        with open(os.path.join(REPO, "docs", "OBSERVABILITY.md")) as f:
+            doc = f.read()
+        assert all(t in doc for t in MEMORY_METRIC_TAGS)
+
+
+class TestModelStateLedgerUnit:
+    def test_ledger_pure_function_matches_known_shapes(self,
+                                                       eight_devices,
+                                                       tmp_path):
+        """680-param MLP at stage 2 on 8 devices: the closed numbers."""
+        engine = _engine({**_tel_cfg(tmp_path, memory={"enabled": True}),
+                          "zero_optimization": {"stage": 2}})
+        ledger = model_state_ledger(engine)
+        assert ledger["total_params"] == 680
+        per = ledger["per_device"]
+        # stage 2: master replicated (fp32), moments + grads sharded /8
+        assert per["master_bytes"] == 680 * 4
+        assert per["grads_bytes"] == 680 * 4 // 8
+        # Adam m+v sharded + its replicated step scalar
+        assert per["optimizer_bytes"] == 2 * 680 * 4 // 8 + 4
+        assert ledger["full"]["optimizer_bytes"] == 2 * 680 * 4 + 4

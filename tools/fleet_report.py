@@ -331,10 +331,15 @@ def render(report: Dict[str, Any]) -> str:
         out.append("")
         out.append(f"fleet spread (flush @ step {report['breakdown_step']}):")
         for field, s in stats.items():
-            out.append(
+            line = (
                 f"  {field:<20} min {s['min']:>12.4g}  "
                 f"median {s['median']:>12.4g}  max {s['max']:>12.4g}  "
                 f"argmax {s.get('argmax_host_name', s['argmax_host'])}")
+            if "argmin_host" in s:
+                # names the tightest host for the headroom field
+                line += (f"  argmin "
+                         f"{s.get('argmin_host_name', s['argmin_host'])}")
+            out.append(line)
     if report.get("persistent_stragglers"):
         out.append("")
         out.append("persistent straggler(s): "

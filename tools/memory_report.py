@@ -1,0 +1,406 @@
+#!/usr/bin/env python
+"""Merge a run's memory telemetry into ONE per-host memory report.
+
+The memory-side companion of goodput_report/fleet_report: feed it the run
+dir (the job's ``telemetry.dir``; docs/OBSERVABILITY.md "Memory
+observatory") and it merges, per host,
+
+- the **model-state ledger** gauges (``memory/ledger_*_bytes`` — master /
+  optimizer / grads / compute-dtype params per device, from the TrainState
+  pytree + ZeRO shardings),
+- the **XLA attribution** gauges (``memory/xla_*_bytes`` from
+  ``compiled.memory_analysis()`` of the step executable),
+- the **HBM watermarks** (``engine/hbm_peak_bytes``,
+  ``memory/hbm_headroom_bytes``, ``memory/hbm_limit_bytes``),
+- the persisted **capacity plan** (``memory_plan*.json`` — the ZeRO
+  stage × offload × microbatch what-if table), and
+- any **OOM crashdumps** (``oom_step*/`` directories written by the
+  observatory's forensics tier: info/memory/ledger/XLA artifacts),
+
+into one table naming the tightest host and rendering the what-if
+projection next to what actually happened.
+
+    python tools/memory_report.py /runs/exp17/telemetry
+    python tools/memory_report.py /runs/exp17/telemetry --crashdumps crashdumps
+    python tools/memory_report.py /runs/exp17/telemetry --json
+    python tools/memory_report.py --selftest
+
+Standalone on purpose: stdlib only, so it runs anywhere the run dir lands
+(including hosts without jax installed).
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+DEFAULT_METRICS_FILE = "metrics.jsonl"
+
+# Keep in sync with deepspeed_tpu/telemetry/memory.py (this tool is
+# import-free by design; tests/test_doc_lint.py pins the doc tables to
+# the package's MEMORY_METRIC_TAGS).
+LEDGER_GAUGES = (
+    "memory/ledger_master_bytes",
+    "memory/ledger_optimizer_bytes",
+    "memory/ledger_grads_bytes",
+    "memory/ledger_compute_params_bytes",
+    "memory/ledger_scalars_bytes",
+    "memory/ledger_device_bytes",
+    "memory/ledger_host_bytes",
+)
+XLA_GAUGES = (
+    "memory/xla_argument_bytes",
+    "memory/xla_output_bytes",
+    "memory/xla_temp_bytes",
+    "memory/xla_alias_bytes",
+    "memory/xla_generated_code_bytes",
+)
+HBM_GAUGES = (
+    "engine/hbm_peak_bytes",
+    "memory/hbm_headroom_bytes",
+    "memory/hbm_limit_bytes",
+)
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+def _host_of_metrics_path(path: str) -> str:
+    """``metrics.jsonl`` -> "local"; ``metrics.<host>.jsonl`` -> host."""
+    name = os.path.basename(path)
+    parts = name.split(".")
+    return parts[1] if len(parts) > 2 else "local"
+
+
+def load_host_metrics(run_dir: str,
+                      metrics_file: str = DEFAULT_METRICS_FILE) -> \
+        Dict[str, Dict[str, float]]:
+    """{host: {tag: latest value}} for the memory-relevant gauges, from
+    plain and host-scoped metrics JSONL files. Torn trailing lines (a
+    crash mid-append) are tolerated."""
+    root, ext = os.path.splitext(metrics_file)
+    paths = sorted(set(glob.glob(os.path.join(run_dir, metrics_file))
+                       + glob.glob(os.path.join(run_dir,
+                                                f"{root}.*{ext}"))))
+    wanted = set(LEDGER_GAUGES) | set(XLA_GAUGES) | set(HBM_GAUGES)
+    out: Dict[str, Dict[str, float]] = {}
+    for path in paths:
+        latest: Dict[str, float] = out.setdefault(
+            _host_of_metrics_path(path), {})
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    tag = row.get("tag")
+                    if tag in wanted and row.get("value") is not None:
+                        latest[tag] = float(row["value"])
+        except OSError:
+            continue
+    return out
+
+
+def load_plans(run_dir: str) -> Dict[str, Dict[str, Any]]:
+    """{host: plan} from ``memory_plan*.json`` (host-scoped or plain)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for path in sorted(glob.glob(os.path.join(run_dir,
+                                              "memory_plan*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        name = os.path.basename(path)
+        parts = name.split(".")
+        host = parts[1] if len(parts) > 2 else "local"
+        out[host] = doc
+    return out
+
+
+def load_crashdumps(dirs: List[str]) -> List[Dict[str, Any]]:
+    """OOM crashdump summaries from every ``oom_step*/`` directory under
+    the given dirs (each dir may BE a dump dir or contain them)."""
+    dumps: List[Dict[str, Any]] = []
+    candidates: List[str] = []
+    for d in dirs:
+        if not d or not os.path.isdir(d):
+            continue
+        if os.path.basename(d).startswith("oom_"):
+            candidates.append(d)
+        candidates.extend(sorted(glob.glob(os.path.join(d, "oom_*"))))
+    seen = set()
+    for path in candidates:
+        real = os.path.realpath(path)
+        if real in seen:
+            continue
+        seen.add(real)
+        info_path = os.path.join(path, "info.json")
+        if not os.path.isfile(info_path):
+            continue
+        try:
+            with open(info_path) as f:
+                info = json.load(f)
+        except (OSError, ValueError):
+            continue
+        dump = {"path": path, "step": info.get("step"),
+                "label": info.get("label"),
+                "error": (info.get("error") or "").splitlines()[:1],
+                "exit_code": info.get("exit_code"),
+                "min_headroom_bytes": None,
+                "ledger_device_bytes": None}
+        try:
+            with open(os.path.join(path, "memory.json")) as f:
+                dump["min_headroom_bytes"] = json.load(f).get(
+                    "min_headroom_bytes")
+        except (OSError, ValueError):
+            pass
+        try:
+            with open(os.path.join(path, "ledger.json")) as f:
+                dump["ledger_device_bytes"] = (json.load(f)
+                                               .get("per_device", {})
+                                               .get("model_state_bytes"))
+        except (OSError, ValueError):
+            pass
+        dumps.append(dump)
+    return dumps
+
+
+# ---------------------------------------------------------------------------
+# Merge
+# ---------------------------------------------------------------------------
+
+def merge_memory(run_dir: str,
+                 crashdump_dirs: Optional[List[str]] = None,
+                 metrics_file: str = DEFAULT_METRICS_FILE) -> Dict[str, Any]:
+    metrics = load_host_metrics(run_dir, metrics_file)
+    plans = load_plans(run_dir)
+    dump_dirs = list(crashdump_dirs or [])
+    # The observatory's default crashdump dir is relative to the child's
+    # cwd; also look beside/inside the run dir for convenience.
+    dump_dirs += [run_dir, os.path.join(run_dir, "crashdumps")]
+    dumps = load_crashdumps(dump_dirs)
+
+    hosts = []
+    for host in sorted(set(metrics) | set(plans)):
+        m = metrics.get(host, {})
+        row = {"host": host}
+        for tag in LEDGER_GAUGES + XLA_GAUGES + HBM_GAUGES:
+            row[tag.split("/")[-1]] = m.get(tag)
+        hosts.append(row)
+    tightest = None
+    with_headroom = [h for h in hosts
+                     if h.get("hbm_headroom_bytes") not in (None, 0)]
+    if with_headroom:
+        tightest = min(with_headroom,
+                       key=lambda h: h["hbm_headroom_bytes"])["host"]
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "n_hosts": len(hosts),
+        "hosts": hosts,
+        "tightest_host": tightest,
+        "plans": plans,
+        "crashdumps": dumps,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+def _gb(v: Optional[float], na: str = "n/a") -> str:
+    return f"{v / 1024**3:.3f}" if v is not None else na
+
+
+def render_plan(plan: Dict[str, Any]) -> str:
+    """The what-if table, from the persisted plan JSON (the package-side
+    twin is telemetry/memory.py render_plan_table)."""
+    lines = [
+        f"capacity plan: {plan.get('total_params', 0) / 1e6:.1f}M params, "
+        f"{plan.get('num_shards', 1)} ZeRO shard(s), microbatch "
+        f"{plan.get('microbatch', 1)}, HBM limit "
+        f"{_gb(plan.get('hbm_limit_bytes'))} GB"]
+    hdr = (f"  {'config':<20} {'model GB':>9} {'device GB':>10} "
+           f"{'host GB':>8} {'headroom GB':>12}  verdict")
+    lines.append(hdr)
+    lines.append("  " + "-" * (len(hdr) - 2))
+    for r in plan.get("rows", []):
+        name = (f"stage{r['stage']}" + ("+offload" if r["offload"] else "")
+                + (" *" if r.get("chosen") else ""))
+        verdict = r.get("verdict", "unknown")
+        lines.append(
+            f"  {name:<20} {_gb(r.get('model_state_bytes')):>9} "
+            f"{_gb(r.get('device_bytes')):>10} {_gb(r.get('host_bytes')):>8} "
+            f"{_gb(r.get('headroom_bytes')):>12}  "
+            f"{verdict.upper() if verdict == 'over' else verdict}")
+    for m in plan.get("microbatch_projection", []):
+        lines.append(f"  microbatch {m['microbatch']:<4} -> device "
+                     f"{_gb(m.get('device_bytes'))} GB  {m.get('verdict')}")
+    return "\n".join(lines)
+
+
+def render(report: Dict[str, Any]) -> str:
+    out = [f"memory report — {report['n_hosts']} host(s) "
+           f"({report['run_dir']})"]
+    if report["hosts"]:
+        out.append("")
+        hdr = (f"{'host':<14} {'master':>8} {'optim':>8} {'grads':>8} "
+               f"{'compute':>8} {'ledger':>8} {'xla args':>9} "
+               f"{'xla temp':>9} {'peak':>8} {'headroom':>9}   (GB)")
+        out.append(hdr)
+        out.append("-" * len(hdr))
+        for h in report["hosts"]:
+            out.append(
+                f"{h['host']:<14} {_gb(h['ledger_master_bytes']):>8} "
+                f"{_gb(h['ledger_optimizer_bytes']):>8} "
+                f"{_gb(h['ledger_grads_bytes']):>8} "
+                f"{_gb(h['ledger_compute_params_bytes']):>8} "
+                f"{_gb(h['ledger_device_bytes']):>8} "
+                f"{_gb(h['xla_argument_bytes']):>9} "
+                f"{_gb(h['xla_temp_bytes']):>9} "
+                f"{_gb(h['hbm_peak_bytes']):>8} "
+                f"{_gb(h['hbm_headroom_bytes']):>9}")
+    if report.get("tightest_host"):
+        out.append("")
+        out.append(f"tightest host (min headroom): "
+                   f"{report['tightest_host']}")
+    for host, plan in sorted(report.get("plans", {}).items()):
+        out.append("")
+        out.append(f"[{host}] " + render_plan(plan))
+    if report.get("crashdumps"):
+        out.append("")
+        out.append("OOM crashdumps:")
+        for d in report["crashdumps"]:
+            err = d["error"][0] if d["error"] else ""
+            out.append(
+                f"  step {d.get('step')} ({d.get('label')}) rc="
+                f"{d.get('exit_code')} headroom "
+                f"{_gb(d.get('min_headroom_bytes'))} GB ledger "
+                f"{_gb(d.get('ledger_device_bytes'))} GB — {err[:80]}")
+            out.append(f"    at {d['path']}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Selftest
+# ---------------------------------------------------------------------------
+
+def _write(path: str, doc: Any) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def _selftest() -> int:
+    """Synthesize a 2-host run dir (host-scoped metrics with ledger/XLA/
+    headroom gauges, a plan with an over-HBM row, an OOM crashdump) and
+    assert the merged report names the tightest host, renders the
+    what-if verdicts, and surfaces the crashdump."""
+    gb = 1024**3
+    with tempfile.TemporaryDirectory() as td:
+        for host, headroom in (("hostA", 4 * gb), ("hostB", 1 * gb)):
+            rows = [
+                {"tag": "memory/ledger_master_bytes", "value": 2 * gb,
+                 "step": 0, "kind": "gauge"},
+                {"tag": "memory/ledger_optimizer_bytes", "value": 4 * gb,
+                 "step": 0, "kind": "gauge"},
+                {"tag": "memory/ledger_grads_bytes", "value": 1 * gb,
+                 "step": 0, "kind": "gauge"},
+                {"tag": "memory/ledger_compute_params_bytes",
+                 "value": 1 * gb, "step": 0, "kind": "gauge"},
+                {"tag": "memory/ledger_device_bytes", "value": 8 * gb,
+                 "step": 0, "kind": "gauge"},
+                {"tag": "memory/xla_argument_bytes", "value": 8.2 * gb,
+                 "step": 1, "kind": "gauge"},
+                {"tag": "memory/xla_temp_bytes", "value": 2.5 * gb,
+                 "step": 1, "kind": "gauge"},
+                {"tag": "engine/hbm_peak_bytes", "value": 11 * gb,
+                 "step": 1, "kind": "gauge"},
+                {"tag": "memory/hbm_headroom_bytes", "value": headroom,
+                 "step": 1, "kind": "gauge"},
+                {"tag": "memory/hbm_limit_bytes", "value": 16 * gb,
+                 "step": 1, "kind": "gauge"},
+            ]
+            with open(os.path.join(td, f"metrics.{host}.jsonl"), "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+                f.write('{"tag": "torn')          # must be tolerated
+        _write(os.path.join(td, "memory_plan.hostA.json"), {
+            "format": 1, "total_params": 1.3e9, "num_shards": 8,
+            "microbatch": 8, "hbm_limit_bytes": 16 * gb,
+            "rows": [
+                {"stage": 0, "offload": False,
+                 "model_state_bytes": 20 * gb, "device_bytes": 20 * gb,
+                 "host_bytes": 0, "headroom_bytes": -4 * gb,
+                 "verdict": "over", "chosen": True},
+                {"stage": 2, "offload": False,
+                 "model_state_bytes": 6 * gb, "device_bytes": 6 * gb,
+                 "host_bytes": 0, "headroom_bytes": 10 * gb,
+                 "verdict": "ok", "chosen": False},
+            ],
+            "microbatch_projection": []})
+        dump = os.path.join(td, "crashdumps", "oom_step7_4711")
+        os.makedirs(dump)
+        _write(os.path.join(dump, "info.json"), {
+            "kind": "oom", "step": 7, "label": "train_step",
+            "pid": 4711, "exit_code": 114,
+            "error": "RESOURCE_EXHAUSTED: Out of memory allocating "
+                     "2147483648 bytes"})
+        _write(os.path.join(dump, "memory.json"),
+               {"devices": [], "min_headroom_bytes": int(0.1 * gb)})
+        _write(os.path.join(dump, "ledger.json"),
+               {"per_device": {"model_state_bytes": 8 * gb}})
+
+        report = merge_memory(td)
+        assert report["n_hosts"] == 2, report
+        assert report["tightest_host"] == "hostB", report
+        by_host = {h["host"]: h for h in report["hosts"]}
+        assert by_host["hostA"]["ledger_device_bytes"] == 8 * gb
+        assert by_host["hostB"]["hbm_headroom_bytes"] == 1 * gb
+        assert len(report["crashdumps"]) == 1
+        assert report["crashdumps"][0]["step"] == 7
+        text = render(report)
+        assert "hostB" in text and "tightest" in text
+        assert "OVER" in text and "stage0 *" in text     # plan verdicts
+        assert "OOM crashdumps" in text
+        assert "RESOURCE_EXHAUSTED" in text
+        json.dumps(report)                                # serializable
+    print("selftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", nargs="?",
+                    help="the job's telemetry.dir (metrics JSONL + "
+                         "memory_plan*.json live there)")
+    ap.add_argument("--crashdumps", action="append", default=None,
+                    metavar="DIR",
+                    help="additional crashdump dir(s) to scan for "
+                         "oom_step*/ dumps (repeatable); the run dir and "
+                         "<run_dir>/crashdumps are always scanned")
+    ap.add_argument("--metrics-file", default=DEFAULT_METRICS_FILE)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in round-trip check and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.run_dir:
+        ap.error("run_dir is required (or --selftest)")
+    report = merge_memory(args.run_dir, crashdump_dirs=args.crashdumps,
+                          metrics_file=args.metrics_file)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
