@@ -243,8 +243,38 @@ def init_inference(model=None, **kwargs):
     return InferenceEngine(model, **kwargs)
 
 
+def init_serving(model=None, config=None, **kwargs):
+    """Serving engine entry — continuous batching over ``init_inference``.
+
+    ``config``: a dict (or JSON path) whose ``serving`` block configures
+    the engine (``config/config.py ServingConfig`` keys) and whose
+    ``telemetry`` block, when enabled, wires the SLO metrics/trace sinks
+    (docs/SERVING.md). All other kwargs go to ``init_inference`` (params,
+    checkpoint, mp_size, quantize, dtype, ...).
+
+    Returns a step-driven :class:`deepspeed_tpu.serving.ServeEngine`:
+    ``submit()`` requests, ``step()`` / ``run_until_complete()`` /
+    ``serve_forever()`` to drive it.
+    """
+    import json as _json
+
+    from deepspeed_tpu.config.config import ServingConfig, TelemetryConfig
+    from deepspeed_tpu.serving.engine import ServeEngine
+    from deepspeed_tpu.telemetry import build_telemetry
+
+    if isinstance(config, str):
+        with open(config) as f:
+            config = _json.load(f)
+    config = dict(config or {})
+    scfg = ServingConfig.from_dict(config.get("serving"))
+    tel = build_telemetry(TelemetryConfig.from_dict(config.get("telemetry")))
+    engine = init_inference(model, tracer=tel.tracer, **kwargs)
+    return ServeEngine(engine, config=scfg, telemetry=tel)
+
+
 __all__ = [
-    "initialize", "init_inference", "add_config_arguments", "init_distributed", "zero_init",
+    "initialize", "init_inference", "init_serving", "add_config_arguments",
+    "init_distributed", "zero_init",
     "build_mesh", "TPUEngine", "TrainState", "DeepSpeedTPUConfig",
     "DeepSpeedDataLoader", "RepeatingLoader", "ProcessTopology",
     "PipeDataParallelTopology", "PipeModelDataParallelTopology",

@@ -6,8 +6,12 @@ step time. ZeRO++ (arXiv 2306.10209) shows blockwise-quantized gradient
 collectives cut that traffic ~4x with negligible quality loss, and EQuARX
 (arXiv 2506.17615) demonstrates the same transformation inside XLA. This
 module is the numeric half of that design: deterministic int8 round-trips
-with per-block fp32 scales, used by :mod:`deepspeed_tpu.comm.grad_sync`
-to compress the DCN stage of the hierarchical gradient sync.
+with per-block fp32 scales. It is the tree's ONE int8 implementation —
+consumers: :mod:`deepspeed_tpu.comm.grad_sync` (DCN stage of the
+hierarchical gradient sync), :mod:`deepspeed_tpu.inference.quantization`
+(int8 weights, one block per (group, output-channel)), and
+:mod:`deepspeed_tpu.serving.kv_cache` (int8 KV pools, one block per
+(token, head) vector).
 
 Properties the grad-sync protocol relies on (tested in tests/test_dcn.py):
 

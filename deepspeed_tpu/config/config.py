@@ -702,6 +702,80 @@ class TelemetryConfig:
 
 
 @dataclass
+class ServingConfig:
+    """``serving`` block — the continuous-batching serving engine
+    (serving/engine.py, docs/SERVING.md).
+
+    ``max_batch_size``: decode slots (the static batch width of the one
+    compiled decode program). ``kv_block_size`` / ``kv_num_blocks``: the
+    paged KV pool geometry — capacity is ``(kv_num_blocks - 1) *
+    kv_block_size`` cache positions (block 0 is reserved scratch).
+    ``int8_kv_cache``: store KV as blockwise int8 + per-(token, head)
+    fp32 scales (comm/quantize.py RTNE). ``max_model_len``: per-sequence
+    prompt+output cap (defaults to the model's max_seq_len).
+    ``max_prefills_per_step``: prefills admitted per decode boundary —
+    bounds how long the decode batch waits on prompt processing.
+    ``temperature``/``top_k``/``seed``: engine-wide sampling policy
+    (0.0 = greedy, byte-reproducible).
+    """
+
+    max_batch_size: int = C.SERVING_MAX_BATCH_SIZE_DEFAULT
+    kv_block_size: int = C.SERVING_KV_BLOCK_SIZE_DEFAULT
+    kv_num_blocks: int = C.SERVING_KV_NUM_BLOCKS_DEFAULT
+    int8_kv_cache: bool = C.SERVING_INT8_KV_CACHE_DEFAULT
+    max_model_len: Optional[int] = None
+    max_prefills_per_step: int = C.SERVING_MAX_PREFILLS_PER_STEP_DEFAULT
+    eos_token_id: Optional[int] = None
+    temperature: float = C.SERVING_TEMPERATURE_DEFAULT
+    top_k: int = C.SERVING_TOP_K_DEFAULT
+    seed: int = C.SERVING_SEED_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
+        d = d or {}
+        cfg = cls(
+            max_batch_size=int(_get(d, C.SERVING_MAX_BATCH_SIZE,
+                                    C.SERVING_MAX_BATCH_SIZE_DEFAULT)),
+            kv_block_size=int(_get(d, C.SERVING_KV_BLOCK_SIZE,
+                                   C.SERVING_KV_BLOCK_SIZE_DEFAULT)),
+            kv_num_blocks=int(_get(d, C.SERVING_KV_NUM_BLOCKS,
+                                   C.SERVING_KV_NUM_BLOCKS_DEFAULT)),
+            int8_kv_cache=bool(_get(d, C.SERVING_INT8_KV_CACHE,
+                                    C.SERVING_INT8_KV_CACHE_DEFAULT)),
+            max_model_len=(int(d[C.SERVING_MAX_MODEL_LEN])
+                           if d.get(C.SERVING_MAX_MODEL_LEN) is not None
+                           else None),
+            max_prefills_per_step=int(_get(
+                d, C.SERVING_MAX_PREFILLS_PER_STEP,
+                C.SERVING_MAX_PREFILLS_PER_STEP_DEFAULT)),
+            eos_token_id=(int(d[C.SERVING_EOS_TOKEN_ID])
+                          if d.get(C.SERVING_EOS_TOKEN_ID) is not None
+                          else None),
+            temperature=float(_get(d, C.SERVING_TEMPERATURE,
+                                   C.SERVING_TEMPERATURE_DEFAULT)),
+            top_k=int(_get(d, C.SERVING_TOP_K, C.SERVING_TOP_K_DEFAULT)),
+            seed=int(_get(d, C.SERVING_SEED, C.SERVING_SEED_DEFAULT)),
+        )
+        if cfg.max_batch_size < 1:
+            raise ConfigError("serving.max_batch_size must be >= 1")
+        if cfg.kv_block_size < 1:
+            raise ConfigError("serving.kv_block_size must be >= 1")
+        if cfg.kv_num_blocks < 2:
+            raise ConfigError(
+                "serving.kv_num_blocks must be >= 2 (block 0 is reserved "
+                "as the scratch block for inactive slots)")
+        if cfg.max_model_len is not None and cfg.max_model_len < 1:
+            raise ConfigError("serving.max_model_len must be >= 1")
+        if cfg.max_prefills_per_step < 1:
+            raise ConfigError("serving.max_prefills_per_step must be >= 1")
+        if cfg.temperature < 0:
+            raise ConfigError("serving.temperature must be >= 0")
+        if cfg.top_k < 0:
+            raise ConfigError("serving.top_k must be >= 0")
+        return cfg
+
+
+@dataclass
 class TensorboardConfig:
     enabled: bool = False
     output_path: str = ""
@@ -830,6 +904,7 @@ class DeepSpeedTPUConfig:
         self.resilience = ResilienceConfig.from_dict(d.get(C.RESILIENCE))
         self.comm = CommConfig.from_dict(d.get(C.COMM))
         self.guardrails = GuardrailsConfig.from_dict(d.get(C.GUARDRAILS))
+        self.serving = ServingConfig.from_dict(d.get(C.SERVING))
         self.sparse_attention = d.get(C.SPARSE_ATTENTION)
         self.pipeline = dict(d.get(C.PIPELINE, {}))
         self.eigenvalue = dict(d.get(C.EIGENVALUE, {}))

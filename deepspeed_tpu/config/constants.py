@@ -221,6 +221,30 @@ TELEMETRY_MEMORY_HBM_LIMIT_GB = "hbm_limit_gb"
 MEMORY_OOM_EXIT_CODE_DEFAULT = 114
 
 #############################################
+# Serving (TPU-native block, no reference analogue: continuous-batching
+# serving engine over the inference stack — serving/; docs/SERVING.md)
+#############################################
+SERVING = "serving"
+SERVING_MAX_BATCH_SIZE = "max_batch_size"
+SERVING_MAX_BATCH_SIZE_DEFAULT = 8            # decode slots
+SERVING_KV_BLOCK_SIZE = "kv_block_size"
+SERVING_KV_BLOCK_SIZE_DEFAULT = 16            # cache positions per block
+SERVING_KV_NUM_BLOCKS = "kv_num_blocks"
+SERVING_KV_NUM_BLOCKS_DEFAULT = 256           # pool size (block 0 = scratch)
+SERVING_INT8_KV_CACHE = "int8_kv_cache"
+SERVING_INT8_KV_CACHE_DEFAULT = False         # blockwise-int8 KV pools
+SERVING_MAX_MODEL_LEN = "max_model_len"       # None -> model max_seq_len
+SERVING_MAX_PREFILLS_PER_STEP = "max_prefills_per_step"
+SERVING_MAX_PREFILLS_PER_STEP_DEFAULT = 1     # prefill/decode interleave cap
+SERVING_EOS_TOKEN_ID = "eos_token_id"         # None -> length-only stopping
+SERVING_TEMPERATURE = "temperature"
+SERVING_TEMPERATURE_DEFAULT = 0.0             # greedy
+SERVING_TOP_K = "top_k"
+SERVING_TOP_K_DEFAULT = 0
+SERVING_SEED = "seed"
+SERVING_SEED_DEFAULT = 0
+
+#############################################
 # Logging / misc
 #############################################
 STEPS_PER_PRINT = "steps_per_print"

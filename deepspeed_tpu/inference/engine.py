@@ -36,6 +36,35 @@ from deepspeed_tpu.inference.quantization import (dequantize_params,
 from deepspeed_tpu.models.partition import build_specs
 from deepspeed_tpu.utils.logging import log_dist
 
+# Smallest prompt bucket: prompts shorter than this share one compiled
+# prefill (the compile-cache floor — a 1-token and a 7-token prompt are
+# not worth distinct programs).
+MIN_PROMPT_BUCKET = 8
+
+
+def bucket_length(t: int, floor: int = MIN_PROMPT_BUCKET,
+                  cap: Optional[int] = None) -> int:
+    """Round ``t`` up to the bucket the jitted prefill compiles for: the
+    next power of two, at least ``floor``, clamped to ``cap`` (the usable
+    context minus the decode budget) but never below ``t`` itself."""
+    b = max(floor, 1 << max(0, (t - 1).bit_length()))
+    if cap is not None:
+        b = min(b, cap)
+    return max(b, t)
+
+
+def sample_logits(logits, rng, temperature: float, top_k: int):
+    """Greedy (``temperature == 0``) or temperature/top-k sampling over
+    ``[B, V]`` fp32 logits — shared by ``generate()`` and the serving
+    engine's decode program (one sampling implementation in the tree)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
 
 class InferenceConfig:
     """Normalized ``init_inference`` kwargs (reference
@@ -45,7 +74,8 @@ class InferenceConfig:
                  quantize: bool = False, quantize_groups: int = 1,
                  replace_with_kernel_inject: bool = True,
                  max_tokens: Optional[int] = None,
-                 recompile_detection: bool = True, **extra):
+                 recompile_detection: bool = True,
+                 bucket_prompts: bool = True, **extra):
         self.mp_size = int(mp_size)
         self.dtype = dtype if dtype is not None else jnp.bfloat16
         self.quantize = bool(quantize)
@@ -53,6 +83,10 @@ class InferenceConfig:
         self.replace_with_kernel_inject = bool(replace_with_kernel_inject)
         self.max_tokens = max_tokens
         self.recompile_detection = bool(recompile_detection)
+        # Pad prompts (left, masked) to power-of-two buckets so varying
+        # prompt lengths hit a bounded set of compiled prefill programs
+        # instead of retracing per length.
+        self.bucket_prompts = bool(bucket_prompts)
         self.extra = extra
 
 
@@ -72,7 +106,7 @@ class InferenceEngine:
                  partition_rules=None, injection_policy=None,
                  mesh: Optional[Mesh] = None,
                  checkpoint: Optional[str] = None,
-                 example_batch: Any = None, **kwargs):
+                 example_batch: Any = None, tracer: Any = None, **kwargs):
         self.module = model
         cfg = config or InferenceConfig(
             mp_size=mp_size, dtype=dtype, quantize=quantize,
@@ -164,9 +198,15 @@ class InferenceEngine:
         # Serving-side retrace alarm (telemetry/recompile.py): a ragged
         # prompt length or dtype drift recompiles prefill+decode per
         # request — seconds of silent tail latency the detector names.
-        from deepspeed_tpu.telemetry import RecompileDetector
+        from deepspeed_tpu.telemetry import RecompileDetector, StepTracer
         self.recompile_detector = RecompileDetector(
             enabled=cfg.recompile_detection)
+        # Inference spans land in the same Perfetto timeline as training:
+        # pass the run's StepTracer (telemetry.tracer) and every
+        # forward/generate dispatch is bracketed; without one the span is
+        # the reusable zero-cost no-op.
+        self.tracer = tracer if tracer is not None else \
+            StepTracer(enabled=False)
 
     # ------------------------------------------------------------------
     def _default_rules(self):
@@ -215,7 +255,8 @@ class InferenceEngine:
                 return self.module.apply({"params": p}, batch,
                                          deterministic=True)
             self._forward_jit = jax.jit(fwd)
-        return self._forward_jit(self.params, batch)
+        with self.tracer.span("inference_forward"):
+            return self._forward_jit(self.params, batch)
 
     __call__ = forward
 
@@ -276,6 +317,26 @@ class InferenceEngine:
             mask = jnp.asarray(mask, jnp.int32)
         else:
             mask = None
+        # --- prompt-length bucketing -----------------------------------
+        # A ragged prompt length retraces the whole prefill+decode program
+        # (seconds of silent stall per NEW length). Left-pad to the next
+        # power-of-two bucket instead: ≤ log2(context) compiled programs
+        # ever, and the existing left-pad masking/position-rebase makes
+        # the padded call token-identical to the unpadded one. The pad
+        # columns are stripped from the returned ids.
+        t_pad = 0
+        if self.config.bucket_prompts:
+            cap = limit - int(max_new_tokens) if limit is not None else None
+            bucket = bucket_length(t0, cap=cap)
+            t_pad = bucket - t0
+            if mask is None:
+                # Always run the masked path when bucketing: a mask that
+                # appears only for non-power-of-two lengths would split
+                # each bucket into two jit signatures.
+                mask = jnp.ones((b, t0), jnp.int32)
+            if t_pad:
+                ids = jnp.pad(ids, ((0, 0), (t_pad, 0)))
+                mask = jnp.pad(mask, ((0, 0), (t_pad, 0)))
         if seed is None:
             # Unseeded sampled calls draw fresh samples each time (counter-
             # mixed); greedy decoding ignores the PRNG so the counter only
@@ -289,23 +350,22 @@ class InferenceEngine:
             {"static": f"max_new_tokens={int(max_new_tokens)},"
                        f"temperature={float(temperature)},"
                        f"top_k={int(top_k)}"})
-        key = (b, t0, int(max_new_tokens), float(temperature), int(top_k),
-               mask is not None)
+        key = (b, int(ids.shape[1]), int(max_new_tokens),
+               float(temperature), int(top_k), mask is not None)
         if key not in self._generate_jit:
             self._generate_jit[key] = jax.jit(functools.partial(
                 self._generate_impl, max_new_tokens=int(max_new_tokens),
                 temperature=float(temperature), top_k=int(top_k)))
-        return self._generate_jit[key](self.params, ids, mask,
-                                       jax.random.PRNGKey(seed))
+        with self.tracer.span("generate", prompt_len=t0,
+                              bucket=int(ids.shape[1]),
+                              new_tokens=int(max_new_tokens)):
+            out = self._generate_jit[key](self.params, ids, mask,
+                                          jax.random.PRNGKey(seed))
+        # Strip the bucket's left-pad columns: callers see [B, T0 + new].
+        return out[:, t_pad:] if t_pad else out
 
     def _sample(self, logits, rng, temperature, top_k):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / temperature
-        if top_k > 0:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+        return sample_logits(logits, rng, temperature, top_k)
 
     def _generate_impl(self, params, ids, mask, rng, *, max_new_tokens,
                        temperature, top_k):

@@ -12,6 +12,15 @@ at once.
 Symmetric linear quantization, matching the reference's quantizer semantics
 (``csrc/quantization/quantizer.cu``): ``q = round(w / s)``, ``s = max|w| /
 127`` per (group, output-channel).
+
+The numeric core is NOT implemented here: the tree has exactly one RTNE
+int8 round-trip — :func:`deepspeed_tpu.comm.quantize.quantize_blockwise`
+(the ZeRO++-style DCN gradient compressor, also the serving tier's int8
+KV-cache quantizer). This module only reshapes weights so that each
+(group, output-channel) column is one quantization block, and inherits
+that implementation's tested properties (deterministic RTNE,
+zero-preserving, max-preserving, overflow-transparent — see
+tests/test_dcn.py).
 """
 
 import re
@@ -20,6 +29,8 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from deepspeed_tpu.comm.quantize import quantize_blockwise
 
 
 @jax.tree_util.register_pytree_node_class
@@ -66,9 +77,13 @@ def _quantize_leaf(w: jax.Array, groups: int) -> QuantizedWeight:
             f"quantize_groups={groups} does not divide leading dim {rows} "
             f"of a {shape} weight; falling back to one scale group for it")
     grouped = jnp.reshape(w.astype(jnp.float32), (g, rows // g) + shape[1:])
-    amax = jnp.max(jnp.abs(grouped), axis=1, keepdims=True)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(grouped / scale), -127, 127).astype(jnp.int8)
+    # Delegate to the shared RTNE core (comm/quantize.py): it quantizes
+    # last-dim blocks, so move the within-group row axis last and make
+    # each (group, output-channel) column exactly one block.
+    moved = jnp.moveaxis(grouped, 1, -1)            # [g, cols..., rows/g]
+    q, scales = quantize_blockwise(moved, rows // g)
+    q = jnp.moveaxis(q, -1, 1)                      # [g, rows/g, cols...]
+    scale = jnp.moveaxis(scales, -1, 1)             # [g, 1, cols...]
     return QuantizedWeight(q, scale, shape)
 
 

@@ -174,8 +174,11 @@ class GPTBlock(nn.Module):
         of the reference inference kernels' attention cache,
         csrc/transformer/inference/). With a cache, new k/v are written at
         ``pos`` and attention runs over the full cache under a
-        position-validity mask (static shapes — jit/scan friendly). Returns
-        ``(x, (k, v))`` in cache mode, plain ``x`` otherwise.
+        position-validity mask (static shapes — jit/scan friendly). A
+        non-tuple cache is taken as a paged-cache layer view
+        (``serving/kv_cache.PagedLayerCache``): it owns the write/gather
+        and per-row positions (continuous batching). Returns
+        ``(x, cache')`` in cache mode, plain ``x`` otherwise.
         """
         cfg = self.cfg
         d = cfg.hidden_size
@@ -202,17 +205,26 @@ class GPTBlock(nn.Module):
         drop_rng = (None if deterministic or cfg.dropout_rate == 0.0
                     else self.make_rng("dropout"))
         if kv_cache is not None:
-            ck, cv = kv_cache
-            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                              (0, pos, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                              (0, pos, 0, 0))
-            kv_cache = (ck, cv)
-            # Key j is visible to query i iff j <= pos + i (cached past plus
-            # the causal prefix of this chunk).
-            qpos = pos + jnp.arange(s)
-            kpos = jnp.arange(ck.shape[1])
-            dec_mask = (kpos[None, :] <= qpos[:, None])[None, None]
+            if isinstance(kv_cache, tuple):
+                ck, cv = kv_cache
+                ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                                  (0, pos, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                                  (0, pos, 0, 0))
+                kv_cache = (ck, cv)
+                # Key j is visible to query i iff j <= pos + i (cached past
+                # plus the causal prefix of this chunk).
+                qpos = pos + jnp.arange(s)
+                kpos = jnp.arange(ck.shape[1])
+                dec_mask = (kpos[None, :] <= qpos[:, None])[None, None]
+            else:
+                # Paged decode (serving/kv_cache.py): the cache object
+                # scatters this chunk through its block table at per-ROW
+                # positions and hands back the gathered static-shape K/V
+                # plus its own visibility mask — rows in a continuous
+                # batch sit at different sequence lengths, so the scalar
+                # ``pos`` is unused here.
+                kv_cache, ck, cv, dec_mask = kv_cache.update(k, v)
             if attn_mask is not None:
                 dec_mask = jnp.logical_and(dec_mask, attn_mask)
             o = attention(q, ck, cv, causal=False, mask=dec_mask,
@@ -325,10 +337,22 @@ class GPT(nn.Module):
                 # key-validity mask (fixed across decode — pad slots stay
                 # masked); a [B, S] mask covers positions pos..pos+S and
                 # keys already cached (< pos) stay visible.
-                lmax = cache[0][0].shape[1]
+                lmax = (cache[0][0].shape[1] if isinstance(cache[0], tuple)
+                        else cache[0].key_len)
                 if am.shape[1] == lmax:
                     km = am.astype(jnp.bool_)
                 else:
+                    if not isinstance(cache[0], tuple):
+                        # Paged caches hold PER-ROW positions: a [B, S]
+                        # chunk mask has no single key offset to land at,
+                        # and splicing it at 0 would silently mask the
+                        # wrong keys for every row.
+                        raise ValueError(
+                            f"paged cache mode takes a full [B, "
+                            f"{lmax}] key-validity attention_mask; got "
+                            f"{tuple(am.shape)} (per-chunk masks cannot "
+                            f"be placed on a shared key axis with "
+                            f"per-row positions)")
                     km = jnp.ones((b, lmax), jnp.bool_)
                     km = jax.lax.dynamic_update_slice(
                         km, am.astype(jnp.bool_),

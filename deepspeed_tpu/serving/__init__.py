@@ -1,0 +1,21 @@
+"""Serving tier — continuous batching, paged KV cache, SLO telemetry.
+
+The production inference path (docs/SERVING.md): a step-driven
+:class:`ServeEngine` doing Orca/vLLM-style in-flight batching over the
+existing :class:`~deepspeed_tpu.inference.engine.InferenceEngine`, with a
+paged blockwise KV cache (optionally int8 via the shared
+``comm/quantize.py`` RTNE core) and serving SLO metrics through the
+telemetry stack. ``deepspeed_tpu.init_serving(...)`` is the one-call
+entry point.
+"""
+
+from deepspeed_tpu.serving.engine import SERVING_METRIC_TAGS, ServeEngine
+from deepspeed_tpu.serving.kv_cache import (BlockPool, PagedLayerCache,
+                                            init_paged_pools, pack_prefill)
+from deepspeed_tpu.serving.scheduler import Request, Scheduler, Sequence
+
+__all__ = [
+    "BlockPool", "PagedLayerCache", "Request", "SERVING_METRIC_TAGS",
+    "ServeEngine", "Scheduler", "Sequence", "init_paged_pools",
+    "pack_prefill",
+]

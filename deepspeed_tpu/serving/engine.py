@@ -1,0 +1,413 @@
+"""ServeEngine — continuous batching + SLO telemetry over the inference stack.
+
+The production serving loop the ROADMAP's "millions of users" story needs,
+layered on what the tree already has: the :class:`InferenceEngine` owns
+params (TP sharding, int8 weights, dtype), ``serving/kv_cache.py`` owns KV
+memory, ``serving/scheduler.py`` owns admission, and the telemetry stack
+(registry/tracer/recompile detector) owns observability.
+
+Execution model — **step-driven, three compiled programs, zero retraces
+in steady state**:
+
+- ``prefill`` (one program per power-of-two prompt **bucket**): a single
+  sequence's prompt runs through the contiguous-cache forward, its first
+  token is sampled in-program, and the per-layer K/V are scattered into
+  the paged pool. Prefill and decode are **disaggregated**: a long prompt
+  costs the decode batch at most ``max_prefills_per_step`` prefill
+  dispatches per step boundary, never a retrace of the decode program.
+- ``decode_step`` (ONE program, ever): the whole slot batch advances one
+  token through the paged cache — fixed batch width, fixed block-table
+  shape, per-row positions. Sequences join/leave by editing host-side
+  numpy inputs, which XLA never sees as a new signature.
+- scheduling between steps is pure host python (microseconds).
+
+SLO telemetry rides the established contract: metrics through the
+``MetricsRegistry`` (no sinks -> no-ops), spans through the ``StepTracer``
+(disabled -> reusable null span, zero device syncs), and
+``tools/serving_report.py`` renders TTFT/throughput/occupancy percentiles
+from the same metrics JSONL the training loop writes.
+"""
+
+import functools
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.engine import (InferenceEngine, bucket_length,
+                                            sample_logits)
+from deepspeed_tpu.serving.kv_cache import (BlockPool, PagedLayerCache,
+                                            init_paged_pools, pack_prefill)
+from deepspeed_tpu.serving.scheduler import Scheduler, Sequence
+from deepspeed_tpu.utils.logging import log_dist
+
+# Every metric tag the serving engine can emit — pinned against
+# docs/OBSERVABILITY.md in both directions by tests/test_doc_lint.py.
+SERVING_METRIC_TAGS = frozenset({
+    "serving/ttft_ms",
+    "serving/tokens_per_sec",
+    "serving/batch_occupancy",
+    "serving/kv_blocks_in_use",
+    "serving/queue_depth",
+    "serving/preempted_seqs",
+    "serving/requests_completed",
+})
+
+
+class ServeEngine:
+    """Continuous-batching serving engine over an :class:`InferenceEngine`.
+
+    ``engine``: an InferenceEngine wrapping a cache-capable causal LM (the
+    in-tree GPT family). ``config``: a parsed ``ServingConfig`` (or None
+    for defaults). ``telemetry``: the run's ``Telemetry`` facade — omit it
+    (or pass a disabled one) and the engine performs zero telemetry
+    work beyond host float arithmetic.
+
+    Thread model: **none required** — ``submit()`` + ``step()`` are plain
+    calls (tier-1 drives them directly); ``serve_forever()`` is a thin
+    loop for a dedicated serving process.
+    """
+
+    def __init__(self, engine: InferenceEngine, config=None,
+                 telemetry=None, capture_logits: bool = False):
+        from deepspeed_tpu.config.config import ServingConfig
+        from deepspeed_tpu.telemetry import null_telemetry
+
+        if engine.model_cfg is None or not hasattr(engine.module, "cfg"):
+            raise ValueError(
+                "ServeEngine needs a cache-capable in-tree causal LM "
+                f"(the GPT family); {type(engine.module).__name__} is not")
+        self.engine = engine
+        self.module = engine.module
+        self.model_cfg = engine.model_cfg
+        self.scfg = config if config is not None else ServingConfig()
+        self.telemetry = telemetry if telemetry is not None \
+            else null_telemetry()
+        self.capture_logits = bool(capture_logits)
+
+        model_max = int(getattr(self.model_cfg, "max_seq_len"))
+        self.max_model_len = min(self.scfg.max_model_len or model_max,
+                                 model_max)
+        bs = self.scfg.kv_block_size
+        self.block_size = bs
+        self.max_blocks = -(-self.max_model_len // bs)   # ceil
+        # Prompt buckets must be BS multiples (whole blocks) and their
+        # positions must exist in the model (wpe rows) AND in the block
+        # table width.
+        self.bucket_cap = min(self.max_blocks * bs, (model_max // bs) * bs)
+        if self.bucket_cap < bs:
+            raise ValueError(
+                f"serving.kv_block_size={bs} exceeds the usable context "
+                f"({model_max}) — no prompt bucket fits")
+
+        self.pool = BlockPool(self.scfg.kv_num_blocks)
+        self.sched = Scheduler(self.scfg.max_batch_size, self.pool, bs)
+        self._dtype = engine.config.dtype
+        self._dtype_name = jnp.dtype(self._dtype).name
+        self._pools = init_paged_pools(
+            self.model_cfg, self.scfg.kv_num_blocks, bs,
+            int8=self.scfg.int8_kv_cache, dtype=self._dtype)
+
+        self._prefill_jit: Dict[int, Any] = {}
+        self._decode_jit = None
+        # Donate the pools: decode/pack rewrite them functionally, and
+        # without donation XLA double-buffers the whole KV cache (2x HBM)
+        # and copies it per token (same rationale as the training
+        # engine's donated TrainState). Backends without donation (CPU
+        # tier-1) just warn and copy.
+        self._pack_jit = jax.jit(pack_prefill, donate_argnums=(0,))
+        self._base_key = jax.random.PRNGKey(self.scfg.seed)
+        self._step_count = 0
+        # Cumulative decode work behind the throughput gauge: a
+        # token-weighted rate (total tokens / total decode seconds) —
+        # a mean over per-step instantaneous rates would overweight
+        # fast steps and overstate throughput exactly when straggler
+        # steps appear.
+        self._decode_tokens = 0
+        self._decode_sec = 0.0
+        self.results: Dict[int, Dict[str, Any]] = {}
+        # Host-side aggregates, kept regardless of telemetry (floats and
+        # ints only — the SLO gauges are derived from these).
+        self.stats = {"decode_steps": 0, "occupancy_sum": 0.0,
+                      "slot_assignments": {}}
+        log_dist(
+            f"serving: {self.scfg.max_batch_size} slots, KV pool "
+            f"{self.pool.capacity}x{bs} positions "
+            f"({'int8' if self.scfg.int8_kv_cache else self._dtype_name}), "
+            f"max_model_len {self.max_model_len}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # submission / retrieval
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               eos_token_id: Optional[int] = None) -> int:
+        """Queue one request; returns its request id. Never blocks —
+        admission happens at the next ``step()`` boundary."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        if len(prompt) > self.bucket_cap:
+            raise ValueError(
+                f"prompt ({len(prompt)}) exceeds the largest prefill "
+                f"bucket ({self.bucket_cap})")
+        if len(prompt) + int(max_new_tokens) > self.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_model_len "
+                f"({self.max_model_len})")
+        bs = self.block_size
+        # Lifetime KV need: the LAST sampled token's KV is never written
+        # (the run ends on it), so the highest write position is
+        # prompt + max_new_tokens - 2.
+        need = max(self._bucket_of(len(prompt)) // bs,
+                   -(-(len(prompt) + int(max_new_tokens) - 1) // bs))
+        if need > self.pool.capacity:
+            raise ValueError(
+                f"request needs {need} KV blocks but the pool holds "
+                f"{self.pool.capacity} — it could never be admitted; "
+                f"raise serving.kv_num_blocks")
+        eos = eos_token_id if eos_token_id is not None \
+            else self.scfg.eos_token_id
+        return self.sched.submit(prompt, int(max_new_tokens), eos)
+
+    def idle(self) -> bool:
+        return self.sched.idle()
+
+    # ------------------------------------------------------------------
+    # the serving step
+    # ------------------------------------------------------------------
+    def step(self) -> Dict[str, Any]:
+        """One engine iteration: admit+prefill (bounded), then advance the
+        whole decode batch one token. Returns a step report
+        (``finished``/``prefilled`` request ids, ``active`` count...)."""
+        info: Dict[str, Any] = {"step": self._step_count, "prefilled": [],
+                                "finished": [], "active": 0}
+
+        # -- admission + prefill (the in-flight batching half) ----------
+        for _ in range(self.scfg.max_prefills_per_step):
+            seq = self.sched.try_admit(self._bucket_of, self._step_count)
+            if seq is None:
+                break
+            self._prefill(seq)
+            info["prefilled"].append(seq.request.rid)
+            self.stats["slot_assignments"].setdefault(seq.slot, 0)
+            self.stats["slot_assignments"][seq.slot] += 1
+            if seq.finished():      # max_new_tokens == 1 / instant EOS
+                self._finish(seq, info)
+
+        # -- decode one token for every running sequence ----------------
+        active = self.sched.active
+        for seq in list(active):
+            if self.sched.running.get(seq.slot) is seq:
+                self.sched.ensure_capacity(seq)
+        active = self.sched.active          # preemption may have evicted
+        info["active"] = len(active)
+        dt_decode = 0.0
+        if active:
+            t_dec = time.perf_counter()
+            toks, logits = self._decode(active)
+            dt_decode = time.perf_counter() - t_dec
+            for seq, tok in zip(active, toks):
+                seq.tokens.append(int(tok))
+                seq.pos += 1
+                if seq.finished():
+                    self._finish(seq, info)
+            if self.capture_logits:
+                info["logits"] = logits
+                info["slots"] = {s.slot: s.request.rid for s in active}
+            self.stats["decode_steps"] += 1
+            self.stats["occupancy_sum"] += \
+                len(active) / self.scfg.max_batch_size
+        # Gauges carry the SAME step index as this iteration's TTFT/
+        # completion rows (emitted above) — increment only afterwards.
+        self._emit_step_metrics(len(active), dt_decode)
+        self._step_count += 1
+        return info
+
+    def run_until_complete(self, max_steps: int = 100_000) -> Dict[int, Any]:
+        """Drive ``step()`` until every submitted request has finished;
+        returns the results map (rid -> record)."""
+        steps = 0
+        while not self.idle():
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"serving did not drain in {max_steps} steps "
+                    f"(queue={self.sched.queue_depth}, "
+                    f"running={len(self.sched.running)})")
+        return self.results
+
+    def serve_forever(self, should_stop=None, idle_sleep: float = 0.002):
+        """Loop ``step()`` until ``should_stop()`` returns True, sleeping
+        briefly when there is no work. The step-driven core stays
+        single-threaded; callers submit from other threads freely (the
+        scheduler's deque append is atomic)."""
+        while should_stop is None or not should_stop():
+            if self.idle():
+                if should_stop is None:
+                    return          # nothing queued and no stop predicate
+                time.sleep(idle_sleep)
+                continue
+            self.step()
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _bucket_of(self, t: int) -> int:
+        b = bucket_length(t, cap=self.bucket_cap)
+        b = -(-b // self.block_size) * self.block_size   # whole blocks
+        return min(max(b, -(-t // self.block_size) * self.block_size),
+                   self.bucket_cap)
+
+    @property
+    def mean_occupancy(self) -> float:
+        n = self.stats["decode_steps"]
+        return self.stats["occupancy_sum"] / n if n else 0.0
+
+    def _finish(self, seq: Sequence, info: Dict[str, Any]) -> None:
+        rid = seq.request.rid
+        self.sched.finish(seq)
+        self.results[rid] = {
+            "tokens": list(seq.tokens),
+            "prompt_len": len(seq.request.prompt),
+            "slot": seq.slot,
+            "finish_step": self._step_count,
+            "ttft_ms": (seq.request.first_token_time
+                        - seq.request.arrival) * 1e3
+            if seq.request.first_token_time else None,
+        }
+        info["finished"].append(rid)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.registry.counter("serving/requests_completed").inc(
+                step=self._step_count)
+
+    # -- prefill --------------------------------------------------------
+    def _prefill(self, seq: Sequence) -> None:
+        t = len(seq.request.prompt)
+        bucket = seq.bucket
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :t] = seq.request.prompt          # right-pad: causal masking
+        dev_ids = jnp.asarray(ids)
+        length = jnp.asarray(t, jnp.int32)       # keeps pads invisible
+        rng = jax.random.fold_in(self._base_key, 2 * seq.request.rid + 1)
+        # Per-bucket detector scope: each bucket's one compile is the
+        # expected first trace, so a healthy engine never warns — a
+        # retrace under any of these names is a real bug.
+        self.engine.recompile_detector.check(
+            f"serving.prefill_b{bucket}", dev_ids, length)
+        if bucket not in self._prefill_jit:
+            self._prefill_jit[bucket] = jax.jit(functools.partial(
+                self._prefill_impl, bucket=bucket))
+        with self.telemetry.span("prefill", rid=seq.request.rid,
+                                 bucket=bucket, prompt_len=t):
+            tok, _logits, ks, vs = self._prefill_jit[bucket](
+                self.engine.params, dev_ids, length, rng)
+            blocks = jnp.asarray(seq.block_table, jnp.int32)
+            self._pools = self._pack_jit(self._pools, blocks, ks, vs)
+            first = int(tok)                     # host fetch = first token
+        now = time.monotonic()
+        seq.tokens.append(first)
+        if seq.request.first_token_time is None:
+            # First prefill only: a preemption restart must not add a
+            # second (and optimistically small) TTFT observation.
+            seq.request.first_token_time = now
+            if self.telemetry.enabled:
+                self.telemetry.registry.histogram(
+                    "serving/ttft_ms").observe(
+                    (now - seq.request.arrival) * 1e3,
+                    step=self._step_count)
+
+    def _prefill_impl(self, params, ids, length, rng, *, bucket: int):
+        from deepspeed_tpu.models.gpt import init_kv_cache
+
+        cache = init_kv_cache(self.model_cfg, 1, bucket, dtype=self._dtype)
+        out = self.module.apply(
+            {"params": self.engine._materialized(params)},
+            {"input_ids": ids}, deterministic=True, cache=cache, pos=0)
+        # Right-padded prompt: causality alone keeps pad positions out of
+        # every real token's attention, so the last REAL position's logits
+        # are exact; pad-position K/V are garbage the position mask hides.
+        last = jax.lax.dynamic_index_in_dim(out["logits"], length - 1,
+                                            axis=1, keepdims=False)  # [1,V]
+        tok = sample_logits(last.astype(jnp.float32), rng,
+                            self.scfg.temperature, self.scfg.top_k)[0]
+        k_stack = jnp.stack([c[0][0] for c in out["cache"]])  # [L,Tb,H,D]
+        v_stack = jnp.stack([c[1][0] for c in out["cache"]])
+        return tok, last, k_stack, v_stack
+
+    # -- decode ---------------------------------------------------------
+    def _decode(self, active: List[Sequence]):
+        nb, mb = self.scfg.max_batch_size, self.max_blocks
+        bt = np.zeros((nb, mb), np.int32)        # inactive rows -> scratch
+        pos = np.zeros((nb,), np.int32)
+        toks = np.zeros((nb,), np.int32)
+        for seq in active:
+            s = seq.slot
+            bt[s, :len(seq.block_table)] = seq.block_table
+            pos[s] = seq.pos
+            toks[s] = seq.tokens[-1]
+        bt, pos, toks = jnp.asarray(bt), jnp.asarray(pos), jnp.asarray(toks)
+        rng = jax.random.fold_in(self._base_key, 2 * self._step_count)
+        self.engine.recompile_detector.check(
+            "serving.decode_step", toks, pos, bt)
+        if self._decode_jit is None:
+            self._decode_jit = jax.jit(self._decode_impl,
+                                       donate_argnums=(1,))
+        with self.telemetry.span("decode_step", active=len(active)):
+            tok_dev, logits, self._pools = self._decode_jit(
+                self.engine.params, self._pools, bt, pos, toks, rng)
+            tok_host = np.asarray(tok_dev)       # host fetch: finish checks
+        logits_host = np.asarray(logits) if self.capture_logits else None
+        return [int(tok_host[s.slot]) for s in active], logits_host
+
+    def _decode_impl(self, params, pools, bt, pos, toks, rng):
+        cache = tuple(
+            PagedLayerCache(*pools[i], bt, pos, self.block_size,
+                            self._dtype_name)
+            for i in range(self.model_cfg.num_layers))
+        out = self.module.apply(
+            {"params": self.engine._materialized(params)},
+            {"input_ids": toks[:, None], "position_ids": pos[:, None]},
+            deterministic=True, cache=cache, pos=None)
+        logits = out["logits"][:, -1].astype(jnp.float32)
+        tok = sample_logits(logits, rng, self.scfg.temperature,
+                            self.scfg.top_k)
+        return tok, logits, tuple(c.pools for c in out["cache"])
+
+    # -- telemetry ------------------------------------------------------
+    def _emit_step_metrics(self, n_active: int, dt_decode: float) -> None:
+        """``dt_decode``: wall seconds of the decode dispatch+fetch only —
+        the throughput gauge means DECODE tokens/s, so prefill/admission
+        time on the same step must not dilute it."""
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        reg = tel.registry
+        step = self._step_count
+        reg.gauge("serving/batch_occupancy").set(
+            n_active / self.scfg.max_batch_size, step=step)
+        reg.gauge("serving/kv_blocks_in_use").set(self.pool.used_blocks,
+                                                  step=step)
+        reg.gauge("serving/queue_depth").set(self.sched.queue_depth,
+                                             step=step)
+        if n_active and dt_decode > 0:
+            self._decode_tokens += n_active
+            self._decode_sec += dt_decode
+            reg.gauge("serving/tokens_per_sec").set(
+                self._decode_tokens / self._decode_sec, step=step)
+        pre = self.sched.preempted_total
+        ctr = reg.counter("serving/preempted_seqs")
+        if pre > ctr.total:
+            ctr.inc(pre - ctr.total, step=step)
+
+    def close(self) -> None:
+        """Flush AND close the telemetry this engine drives (sink file
+        handles, tracer) — init_serving hands the engine ownership."""
+        self.telemetry.close()

@@ -1,0 +1,241 @@
+"""Paged/blockwise KV cache — the serving tier's memory system.
+
+vLLM's PagedAttention insight (arXiv 2309.06180) re-done TPU-native: the
+KV cache is a **preallocated pool of fixed-size blocks** plus per-sequence
+**block tables**, so sequences of wildly different lengths share one HBM
+allocation with no fragmentation and no reallocation as they grow. Every
+device op here is **static-shape** — pool, block table and gather sizes
+are fixed at engine build — so XLA compiles the decode program once and
+never retraces as sequences grow, join or leave (the per-request
+``dynamic_update_slice`` cache of ``inference/engine.py`` recompiles per
+(batch, length) pair; this is what replaces it under continuous batching).
+
+Layout (per transformer layer, all layers share one block table):
+
+- ``k``/``v`` pool: ``[num_blocks, block_size, heads, head_dim]`` in the
+  model's compute dtype — or **int8** with per-(token, head) fp32 scales
+  ``[num_blocks, block_size, heads]`` when ``int8=True``. Quantization is
+  the SAME deterministic RTNE blockwise round-trip the DCN gradient path
+  uses (:func:`deepspeed_tpu.comm.quantize.quantize_blockwise` with
+  ``block_size=head_dim``) — one int8 implementation in the tree.
+- block table: ``[batch_slots, max_blocks_per_seq]`` int32, row ``b``
+  listing the pool blocks of the sequence in slot ``b``. **Block 0 is a
+  reserved scratch block**: inactive slots point at it, so their (masked,
+  discarded) decode writes land somewhere harmless and the program needs
+  no branch on slot liveness.
+
+Host-side block accounting (:class:`BlockPool`) is plain python — a free
+list is microseconds per step and never touches the device.
+"""
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm.quantize import quantize_blockwise
+
+
+class BlockPool:
+    """Host-side free-list allocator over ``num_blocks`` pool slots.
+
+    Block 0 is reserved as the scratch block for inactive batch slots and
+    is never handed out; ``capacity`` is therefore ``num_blocks - 1``.
+    """
+
+    SCRATCH = 0
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (1 is reserved scratch), "
+                             f"got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(1, self.num_blocks))
+        # Mirror of _free for O(1) double-free checks: releasing a long
+        # sequence must stay microseconds even at multi-thousand-block
+        # pools.
+        self._free_set = set(self._free)
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.capacity - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` blocks or None (never a partial grant — the caller either
+        admits a sequence whole or leaves it queued)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        taken, self._free = self._free[:n], self._free[n:]
+        self._free_set.difference_update(taken)
+        return taken
+
+    def release(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b == self.SCRATCH:
+                raise ValueError("scratch block cannot be released")
+            if b in self._free_set:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)
+        self._free_set.update(blocks)
+
+
+def init_paged_pools(cfg, num_blocks: int, block_size: int,
+                     int8: bool = False, dtype=None) -> Tuple:
+    """Per-layer ``(k, v, k_scale, v_scale)`` pool arrays (scales are None
+    in the fp path). Zero-initialised: scratch/unwritten slots dequantize
+    to exact zeros, so masked attention terms stay exactly ``0 * 0``."""
+    dtype = dtype if dtype is not None else cfg.dtype
+    shape = (num_blocks, block_size, cfg.num_heads, cfg.head_dim)
+    sshape = (num_blocks, block_size, cfg.num_heads)
+    layers = []
+    for _ in range(cfg.num_layers):
+        if int8:
+            layers.append((jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape, jnp.int8),
+                           jnp.ones(sshape, jnp.float32),
+                           jnp.ones(sshape, jnp.float32)))
+        else:
+            layers.append((jnp.zeros(shape, dtype),
+                           jnp.zeros(shape, dtype), None, None))
+    return tuple(layers)
+
+
+def _quant_tokens(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[..., H, D] float -> (int8 [..., H, D], fp32 scales [..., H]) —
+    one RTNE quantization block per (token, head) vector."""
+    q, s = quantize_blockwise(x.astype(jnp.float32), x.shape[-1])
+    return q, s[..., 0]        # head_dim is one block: drop the block axis
+
+
+@jax.tree_util.register_pytree_node_class
+class PagedLayerCache:
+    """One layer's view of the paged cache inside a jitted decode/prefill
+    program: pools + the batch's block table and write positions.
+
+    Passed as the per-layer cache to the GPT family's cache mode; the
+    block calls :meth:`update` with this step's ``k``/``v`` chunk and gets
+    back the updated cache, the full gathered K/V and the key-validity
+    mask. All shapes are static: the gather is always
+    ``[B, max_blocks * block_size, H, D]`` regardless of true lengths.
+    """
+
+    def __init__(self, k: jax.Array, v: jax.Array,
+                 k_scale: Optional[jax.Array], v_scale: Optional[jax.Array],
+                 block_table: jax.Array, pos: jax.Array,
+                 block_size: int, dtype_name: str = "bfloat16"):
+        self.k = k
+        self.v = v
+        self.k_scale = k_scale
+        self.v_scale = v_scale
+        self.block_table = block_table      # [B, MB] int32
+        self.pos = pos                      # [B] int32 — next write index
+        self.block_size = int(block_size)
+        self.dtype_name = dtype_name
+
+    # -- pytree ---------------------------------------------------------
+    def tree_flatten(self):
+        return ((self.k, self.v, self.k_scale, self.v_scale,
+                 self.block_table, self.pos),
+                (self.block_size, self.dtype_name))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, block_size=aux[0], dtype_name=aux[1])
+
+    # -- properties -----------------------------------------------------
+    @property
+    def int8(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def key_len(self) -> int:
+        """Static gathered key-axis length (max_blocks * block_size)."""
+        return self.block_table.shape[1] * self.block_size
+
+    @property
+    def pools(self) -> Tuple:
+        return (self.k, self.v, self.k_scale, self.v_scale)
+
+    # -- traced ops -----------------------------------------------------
+    def _write(self, pool, scale, chunk):
+        """Scatter ``chunk`` [B, S, H, D] at per-row positions
+        ``pos..pos+S-1`` through the block table."""
+        b, s = chunk.shape[:2]
+        idx = self.pos[:, None] + jnp.arange(s)[None, :]        # [B, S]
+        rows = jnp.arange(b)[:, None]
+        blk = self.block_table[rows, idx // self.block_size]     # [B, S]
+        off = idx % self.block_size
+        if scale is not None:
+            q, sc = _quant_tokens(chunk)
+            return pool.at[blk, off].set(q), scale.at[blk, off].set(sc)
+        return pool.at[blk, off].set(chunk.astype(pool.dtype)), None
+
+    def _gather(self, pool, scale):
+        """[B, MB, BS, H, D] pool gather -> [B, L, H, D] keys/values."""
+        b, mb = self.block_table.shape
+        g = pool[self.block_table]                # [B, MB, BS, H, D]
+        g = g.reshape(b, self.key_len, *pool.shape[2:])
+        if scale is not None:
+            # Per-(token, head) dequant — the inverse of _quant_tokens'
+            # head_dim-block RTNE (comm/quantize.py round-trip semantics).
+            sc = scale[self.block_table].reshape(b, self.key_len,
+                                                 scale.shape[-1])
+            g = g.astype(jnp.float32) * sc[..., None]
+        return g.astype(jnp.dtype(self.dtype_name))
+
+    def update(self, k_new: jax.Array, v_new: jax.Array):
+        """Write this step's ``[B, S, H, D]`` chunk, gather the full cache.
+
+        Returns ``(new_cache, K [B, L, H, D], V, mask [B, 1, S, L])`` where
+        the mask makes key ``j`` visible to query ``i`` iff
+        ``j <= pos + i`` — the cached past plus this chunk's causal prefix
+        (scratch and not-yet-written slots are always masked out).
+        """
+        b, s = k_new.shape[:2]
+        k, ks = self._write(self.k, self.k_scale, k_new)
+        v, vs = self._write(self.v, self.v_scale, v_new)
+        new = PagedLayerCache(k, v, ks, vs, self.block_table, self.pos,
+                              self.block_size, self.dtype_name)
+        kk = new._gather(k, ks)
+        vv = new._gather(v, vs)
+        qpos = self.pos[:, None] + jnp.arange(s)[None, :]        # [B, S]
+        kpos = jnp.arange(self.key_len)
+        mask = kpos[None, None, :] <= qpos[:, :, None]           # [B, S, L]
+        return new, kk, vv, mask[:, None]                        # [B,1,S,L]
+
+
+def pack_prefill(pools: Tuple, blocks: jax.Array,
+                 k_stack: jax.Array, v_stack: jax.Array) -> Tuple:
+    """Scatter a prefilled contiguous cache into pool blocks (jit this).
+
+    ``pools``: the per-layer ``(k, v, k_scale, v_scale)`` tuple;
+    ``blocks``: [nb] int32 pool blocks assigned to the sequence;
+    ``k_stack``/``v_stack``: [layers, T, H, D] from the prefill forward,
+    with ``T == nb * block_size`` (bucketed — trailing positions beyond
+    the true prompt length carry garbage that stays masked by ``pos``).
+    """
+    nb = blocks.shape[0]
+    out = []
+    for i, (k, v, ks, vs) in enumerate(pools):
+        bs = k.shape[1]
+        kb = k_stack[i].reshape(nb, bs, *k.shape[2:])
+        vb = v_stack[i].reshape(nb, bs, *v.shape[2:])
+        if ks is not None:
+            kq, ksc = _quant_tokens(kb)
+            vq, vsc = _quant_tokens(vb)
+            out.append((k.at[blocks].set(kq), v.at[blocks].set(vq),
+                        ks.at[blocks].set(ksc), vs.at[blocks].set(vsc)))
+        else:
+            out.append((k.at[blocks].set(kb.astype(k.dtype)),
+                        v.at[blocks].set(vb.astype(v.dtype)), None, None))
+    return tuple(out)

@@ -50,8 +50,8 @@ __all__ = [
     "Telemetry", "TensorboardSink", "build_fleet", "build_goodput",
     "build_memory_observatory", "build_telemetry",
     "collect_memory_snapshot", "default_host", "host_scoped_path",
-    "model_state_ledger", "plan_capacity", "telemetry_host_component",
-    "tree_signature",
+    "model_state_ledger", "null_telemetry", "plan_capacity",
+    "telemetry_host_component", "tree_signature",
 ]
 
 

@@ -163,6 +163,13 @@ class Histogram(_Metric):
     def percentiles(self, qs: Sequence[float]) -> Tuple[float, ...]:
         return tuple(self.percentile(q) for q in qs)
 
+    def reset(self) -> None:
+        """Drop the host-side reservoir (e.g. to exclude warmup
+        observations from percentiles). Rows already emitted to sinks
+        are untouched."""
+        self._sorted.clear()
+        self.count = 0
+
 
 class MetricsRegistry:
     """Named metrics + fan-out to sinks. Thread-safe: the checkpoint writer

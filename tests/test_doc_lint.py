@@ -16,6 +16,7 @@ for tier-1.
 import os
 import re
 
+from deepspeed_tpu.serving.engine import SERVING_METRIC_TAGS
 from deepspeed_tpu.telemetry.fleet import FLEET_METRIC_TAGS
 from deepspeed_tpu.telemetry.goodput import GOODPUT_METRIC_TAGS
 from deepspeed_tpu.telemetry.memory import MEMORY_METRIC_TAGS
@@ -30,6 +31,7 @@ _METRIC_CALL_RE = re.compile(
 _GOODPUT_TOKEN_RE = re.compile(r"goodput/[A-Za-z_]+")
 _FLEET_TOKEN_RE = re.compile(r"fleet/[A-Za-z_]+")
 _MEMORY_TOKEN_RE = re.compile(r"memory/[A-Za-z_]+")
+_SERVING_TOKEN_RE = re.compile(r"serving/[A-Za-z_]+")
 
 
 def _iter_py_files():
@@ -135,6 +137,42 @@ class TestDocDrift:
         assert not phantom, (
             f"docs/OBSERVABILITY.md names memory tags the code never "
             f"emits: {phantom}")
+
+    def test_serving_tags_documented_and_vice_versa(self):
+        """The serving SLO surface (serving/engine.py) is pinned in BOTH
+        directions like goodput/fleet/memory: every tag in
+        SERVING_METRIC_TAGS must be in the doc, and every serving/* token
+        the doc names must be one the code emits."""
+        doc = _doc_text()
+        undocumented = sorted(t for t in SERVING_METRIC_TAGS
+                              if t not in doc)
+        assert not undocumented, undocumented
+        doc_tokens = set(_SERVING_TOKEN_RE.findall(doc))
+        phantom = sorted(t for t in doc_tokens
+                         if t not in SERVING_METRIC_TAGS)
+        assert not phantom, (
+            f"docs/OBSERVABILITY.md names serving tags the code never "
+            f"emits: {phantom}")
+        # every literal serving/* emission in the tree is a declared tag
+        emitted = {t for _, _, t in _emitted_literals()
+                   if t.startswith("serving/")}
+        assert emitted, "the scan must see the serving emissions"
+        assert emitted <= SERVING_METRIC_TAGS, (
+            emitted - SERVING_METRIC_TAGS)
+
+    def test_serving_report_tags_in_sync(self):
+        """tools/serving_report.py is stdlib-only by design (no package
+        import), so its private tag tuples are pinned here instead —
+        every tag the report reads must be one the engine emits."""
+        with open(os.path.join(REPO, "tools", "serving_report.py")) as f:
+            src = f.read()
+        report_tags = set(re.findall(r'"(serving/[A-Za-z_]+)"', src))
+        assert report_tags, "scan must see serving_report's tags"
+        phantom = sorted(t for t in report_tags
+                         if t not in SERVING_METRIC_TAGS)
+        assert not phantom, (
+            f"tools/serving_report.py reads tags the code never emits: "
+            f"{phantom} — keep it in sync with serving/engine.py")
 
     def test_memory_report_gauges_in_sync(self):
         """tools/memory_report.py is stdlib-only by design (no package

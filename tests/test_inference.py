@@ -227,6 +227,108 @@ class TestInitInferenceAPI:
         assert np.asarray(out).shape == (2, ids.shape[1] + 2)
 
 
+class TestPromptBucketing:
+    """generate() pads prompts to power-of-two buckets (left, masked):
+    varying lengths must share ONE compiled program per bucket instead of
+    retracing per length — proven through the RecompileDetector."""
+
+    def test_one_compile_per_bucket(self, gpt_setup):
+        model, cfg, params, ids = gpt_setup
+        engine = deepspeed_tpu.init_inference(model, params=params,
+                                              dtype=jnp.float32)
+        for t0 in (5, 6, 7, 8):               # all land in the 8-bucket
+            engine.generate(ids[:, :t0], max_new_tokens=4)
+        det = engine.recompile_detector
+        assert det.compiles("inference.generate") == 1, det.stats
+        assert len(engine._generate_jit) == 1
+        long_ids = jnp.tile(jnp.asarray(ids), (1, 2))     # [2, 16]
+        for t0 in (9, 11, 16):                # the 16-bucket
+            engine.generate(long_ids[:, :t0], max_new_tokens=4)
+        assert det.compiles("inference.generate") == 2, det.stats
+        assert len(engine._generate_jit) == 2
+
+    def test_bucketed_matches_unbucketed(self, gpt_setup):
+        model, cfg, params, ids = gpt_setup
+        bucketed = deepspeed_tpu.init_inference(model, params=params,
+                                                dtype=jnp.float32)
+        plain = deepspeed_tpu.init_inference(model, params=params,
+                                             dtype=jnp.float32,
+                                             bucket_prompts=False)
+        for t0 in (3, 5, 7, 8):
+            got = np.asarray(bucketed.generate(ids[:, :t0],
+                                               max_new_tokens=5))
+            want = np.asarray(plain.generate(ids[:, :t0],
+                                             max_new_tokens=5))
+            np.testing.assert_array_equal(got, want)
+            assert got.shape == (2, t0 + 5)   # pad columns stripped
+
+    def test_bucket_respects_context_cap(self, gpt_setup):
+        """At the context boundary the bucket is clamped so prompt +
+        decode still fits; generation succeeds rather than overflowing
+        the cache."""
+        model, cfg, params, ids = gpt_setup
+        engine = deepspeed_tpu.init_inference(model, params=params,
+                                              dtype=jnp.float32)
+        t0 = 20                                # pow2 bucket would be 32
+        mnt = cfg.max_seq_len - t0             # exactly fills the context
+        big = jnp.tile(ids, (1, 4))[:, :t0]
+        out = engine.generate(big, max_new_tokens=mnt)
+        assert np.asarray(out).shape == (2, cfg.max_seq_len)
+
+
+class TestQuantizerUnification:
+    """inference/quantization.py carries NO quantizer of its own: it
+    reshapes onto comm/quantize.py's RTNE core (one int8 implementation
+    in the tree) and inherits its tested properties."""
+
+    def test_delegates_to_comm_core(self, gpt_setup, monkeypatch):
+        _, _, params, _ = gpt_setup
+        import deepspeed_tpu.inference.quantization as iq
+        calls = {"n": 0}
+        real = iq.quantize_blockwise
+
+        def spy(x, block_size, bits=8):
+            calls["n"] += 1
+            return real(x, block_size, bits)
+
+        monkeypatch.setattr(iq, "quantize_blockwise", spy)
+        q = quantize_params(params, min_size=16)
+        n_q = sum(isinstance(l, QuantizedWeight)
+                  for l in jax.tree_util.tree_leaves(
+                      q, is_leaf=lambda x: isinstance(x, QuantizedWeight)))
+        assert n_q > 0 and calls["n"] == n_q
+
+    def test_roundtrip_equals_comm_roundtrip(self):
+        """The weight quantizer's round-trip is EXACTLY the comm core's
+        on the moved-axis layout — shared semantics, not merely close."""
+        from deepspeed_tpu.comm.quantize import (dequantize_blockwise,
+                                                 quantize_blockwise)
+        from deepspeed_tpu.inference.quantization import _quantize_leaf
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(16, 6)).astype(np.float32))
+        qw = _quantize_leaf(w, groups=4)
+        got = np.asarray(qw.dequantize(jnp.float32))
+        moved = jnp.moveaxis(w.reshape(4, 4, 6), 1, -1)   # [4, 6, 4]
+        q, s = quantize_blockwise(moved, 4)
+        want = jnp.moveaxis(dequantize_blockwise(q, s, 4), -1, 1)
+        np.testing.assert_array_equal(got, np.asarray(want.reshape(16, 6)))
+
+    def test_comm_properties_inherited(self):
+        """Zero-preserving and max-preserving — the comm/quantize.py
+        contract, now holding for weight quantization by construction."""
+        from deepspeed_tpu.inference.quantization import _quantize_leaf
+        z = jnp.zeros((8, 4), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(_quantize_leaf(z, 2).dequantize(jnp.float32)), 0.0)
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.normal(size=(32, 5)).astype(np.float32))
+        deq = np.asarray(_quantize_leaf(w, 4).dequantize(jnp.float32))
+        grouped = np.asarray(w).reshape(4, 8, 5)
+        amax = np.abs(grouped).max(axis=1)
+        amax_rt = np.abs(deq.reshape(4, 8, 5)).max(axis=1)
+        np.testing.assert_allclose(amax_rt, amax, rtol=1e-6)
+
+
 class TestReviewRegressions:
     def test_generate_past_context_raises(self, gpt_setup):
         model, cfg, params, ids = gpt_setup

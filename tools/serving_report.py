@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Render a serving run's SLO metrics from its telemetry JSONL.
+
+The serving-side companion of goodput_report/fleet_report/memory_report:
+feed it the run dir (the job's ``telemetry.dir``; docs/SERVING.md) or a
+metrics file and it aggregates the ``serving/*`` rows the
+:class:`ServeEngine` emits —
+
+- **TTFT** (``serving/ttft_ms`` histogram observations) -> p50/p90/p99 —
+  the user-facing latency SLO;
+- **throughput** (``serving/tokens_per_sec`` gauge — the engine emits a
+  CUMULATIVE token-weighted rate, total decoded tokens / total decode
+  seconds) -> overall (final cumulative value, averaged across host
+  files) and peak running rate;
+- **batch occupancy** (``serving/batch_occupancy``) -> mean/p10 — how
+  full the decode batch ran (the continuous-batching win over static
+  batching is this number);
+- **KV pressure** (``serving/kv_blocks_in_use`` peak,
+  ``serving/preempted_seqs`` total) and **queueing**
+  (``serving/queue_depth`` mean/max);
+- completion counts (``serving/requests_completed``).
+
+    python tools/serving_report.py /runs/serve17/telemetry
+    python tools/serving_report.py /runs/serve17/telemetry --json
+    python tools/serving_report.py --selftest
+
+Standalone on purpose: stdlib only, so it runs anywhere the run dir
+lands (including hosts without jax installed). Keep the tag strings in
+sync with deepspeed_tpu/serving/engine.py SERVING_METRIC_TAGS —
+tests/test_doc_lint.py pins them.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List
+
+DEFAULT_METRICS_FILE = "metrics.jsonl"
+
+HIST_TAGS = ("serving/ttft_ms",)
+GAUGE_TAGS = (
+    "serving/tokens_per_sec",
+    "serving/batch_occupancy",
+    "serving/kv_blocks_in_use",
+    "serving/queue_depth",
+)
+COUNTER_TAGS = (
+    "serving/preempted_seqs",
+    "serving/requests_completed",
+)
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Linear-interpolated percentile over an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo)
+
+
+def _iter_rows(path: str):
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue          # torn tail line of a live/killed run
+            if isinstance(row, dict) and "tag" in row:
+                yield row
+
+
+def collect(run_dir_or_file: str,
+            metrics_file: str = DEFAULT_METRICS_FILE) -> Dict[str, Any]:
+    """Aggregate serving/* rows from one metrics file or every
+    ``metrics*.jsonl`` in a run dir (multi-host runs host-scope the
+    name)."""
+    if os.path.isdir(run_dir_or_file):
+        stem, ext = os.path.splitext(metrics_file)
+        paths = sorted(glob.glob(
+            os.path.join(run_dir_or_file, f"{stem}*{ext}")))
+    else:
+        paths = [run_dir_or_file]
+    series: Dict[str, List[float]] = {}
+    counters: Dict[str, float] = {}
+    n_rows = 0
+    for path in paths:
+        if not os.path.exists(path):
+            continue
+        # Counters emit their RUNNING TOTAL: within one host's file the
+        # max IS the final count (never double-count rows), while
+        # distinct host-scoped files are distinct engines whose finals
+        # must SUM.
+        per_file: Dict[str, float] = {}
+        last_tps = None
+        for row in _iter_rows(path):
+            tag = row["tag"]
+            if not tag.startswith("serving/"):
+                continue
+            n_rows += 1
+            val = float(row.get("value", 0.0))
+            if tag in COUNTER_TAGS:
+                per_file[tag] = max(per_file.get(tag, 0.0), val)
+            else:
+                if tag == "serving/tokens_per_sec":
+                    last_tps = val        # cumulative rate: last = final
+                series.setdefault(tag, []).append(val)
+        for tag, val in per_file.items():
+            counters[tag] = counters.get(tag, 0.0) + val
+        if last_tps is not None:
+            series.setdefault("_tps_final_per_file", []).append(last_tps)
+
+    report: Dict[str, Any] = {"files": [os.path.basename(p) for p in paths],
+                              "n_rows": n_rows}
+    ttft = sorted(series.get("serving/ttft_ms", []))
+    report["requests_with_ttft"] = len(ttft)
+    report["ttft_ms"] = {"p50": _percentile(ttft, 50),
+                         "p90": _percentile(ttft, 90),
+                         "p99": _percentile(ttft, 99)} if ttft else None
+    tps = series.get("serving/tokens_per_sec", [])
+    finals = series.get("_tps_final_per_file", [])
+    report["tokens_per_sec"] = {
+        # the gauge is a cumulative token-weighted rate: the final value
+        # per host file IS that host's run throughput, and distinct
+        # hosts' engines SUM (like the counters above)
+        "overall": sum(finals),
+        "peak": max(tps)} if tps else None
+    occ = sorted(series.get("serving/batch_occupancy", []))
+    report["batch_occupancy"] = {
+        "mean": sum(occ) / len(occ),
+        "p10": _percentile(occ, 10)} if occ else None
+    blocks = series.get("serving/kv_blocks_in_use", [])
+    report["kv_blocks_in_use_peak"] = max(blocks) if blocks else None
+    queue = series.get("serving/queue_depth", [])
+    report["queue_depth"] = {
+        "mean": sum(queue) / len(queue),
+        "max": max(queue)} if queue else None
+    report["preempted_seqs"] = counters.get("serving/preempted_seqs", 0.0)
+    report["requests_completed"] = counters.get(
+        "serving/requests_completed", 0.0)
+    return report
+
+
+def render(report: Dict[str, Any]) -> str:
+    out = ["serving SLO report"]
+    out.append(f"  files: {', '.join(report['files']) or '<none>'} "
+               f"({report['n_rows']} serving rows)")
+    if report["ttft_ms"]:
+        t = report["ttft_ms"]
+        out.append(f"  TTFT            p50 {t['p50']:9.1f} ms   "
+                   f"p90 {t['p90']:9.1f} ms   p99 {t['p99']:9.1f} ms  "
+                   f"({report['requests_with_ttft']} requests)")
+    if report["tokens_per_sec"]:
+        t = report["tokens_per_sec"]
+        out.append(f"  throughput      overall {t['overall']:8.1f} tok/s   "
+                   f"peak {t['peak']:8.1f} tok/s")
+    if report["batch_occupancy"]:
+        o = report["batch_occupancy"]
+        out.append(f"  occupancy       mean {o['mean']:8.1%}   "
+                   f"p10 {o['p10']:8.1%}")
+    if report["kv_blocks_in_use_peak"] is not None:
+        out.append(f"  KV blocks peak  {report['kv_blocks_in_use_peak']:.0f}"
+                   f"   preempted {report['preempted_seqs']:.0f}")
+    if report["queue_depth"]:
+        q = report["queue_depth"]
+        out.append(f"  queue depth     mean {q['mean']:8.2f}   "
+                   f"max {q['max']:.0f}")
+    out.append(f"  completed       {report['requests_completed']:.0f} "
+               f"requests")
+    if not report["n_rows"]:
+        out.append("  (no serving/* rows found — was the engine run with "
+                   "telemetry enabled?)")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Selftest
+# ---------------------------------------------------------------------------
+
+def _selftest() -> int:
+    """Synthesize a serving metrics JSONL (two host-scoped files, a torn
+    tail line) and assert the aggregation: TTFT percentiles, occupancy
+    mean, counter totals max-within-file / summed-across-hosts."""
+    with tempfile.TemporaryDirectory() as td:
+        rows_a = [
+            {"tag": "serving/ttft_ms", "value": float(v), "step": i,
+             "kind": "histogram"}
+            for i, v in enumerate((10, 20, 30, 40, 50, 60, 70, 80, 90, 100))
+        ] + [
+            {"tag": "serving/batch_occupancy", "value": 0.75, "step": 1,
+             "kind": "gauge"},
+            {"tag": "serving/batch_occupancy", "value": 0.25, "step": 2,
+             "kind": "gauge"},
+            {"tag": "serving/tokens_per_sec", "value": 100.0, "step": 1,
+             "kind": "gauge"},
+            {"tag": "serving/tokens_per_sec", "value": 300.0, "step": 2,
+             "kind": "gauge"},
+            {"tag": "serving/kv_blocks_in_use", "value": 17, "step": 2,
+             "kind": "gauge"},
+            {"tag": "serving/queue_depth", "value": 3, "step": 1,
+             "kind": "gauge"},
+            {"tag": "serving/preempted_seqs", "value": 2, "step": 2,
+             "kind": "counter"},
+            {"tag": "serving/requests_completed", "value": 5, "step": 2,
+             "kind": "counter"},
+            {"tag": "engine/hbm_peak_bytes", "value": 1, "step": 0,
+             "kind": "gauge"},                     # non-serving: ignored
+        ]
+        with open(os.path.join(td, "metrics.hostA.jsonl"), "w") as f:
+            for r in rows_a:
+                f.write(json.dumps(r) + "\n")
+            f.write('{"tag": "torn')               # must be tolerated
+        with open(os.path.join(td, "metrics.hostB.jsonl"), "w") as f:
+            f.write(json.dumps(
+                {"tag": "serving/requests_completed", "value": 3,
+                 "step": 2, "kind": "counter"}) + "\n")
+            f.write(json.dumps(
+                {"tag": "serving/tokens_per_sec", "value": 200.0,
+                 "step": 2, "kind": "gauge"}) + "\n")
+
+        report = collect(td)
+        assert report["requests_with_ttft"] == 10, report
+        assert abs(report["ttft_ms"]["p50"] - 55.0) < 1e-6, report
+        assert report["ttft_ms"]["p99"] > 90, report
+        assert abs(report["batch_occupancy"]["mean"] - 0.5) < 1e-6
+        # cumulative-rate gauge: each file's LAST value is that host's
+        # throughput; hosts sum (300 from hostA + 200 from hostB)
+        assert report["tokens_per_sec"]["overall"] == 500.0
+        assert report["tokens_per_sec"]["peak"] == 300.0
+        assert report["kv_blocks_in_use_peak"] == 17
+        assert report["preempted_seqs"] == 2
+        # running totals: max within a file, summed across host files
+        assert report["requests_completed"] == 8
+        text = render(report)
+        assert "TTFT" in text and "occupancy" in text
+        assert "completed" in text
+        json.dumps(report)                         # serializable
+    print("\nselftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", nargs="?",
+                    help="the job's telemetry.dir (or a metrics JSONL "
+                         "file)")
+    ap.add_argument("--metrics-file", default=DEFAULT_METRICS_FILE)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in round-trip check and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.run_dir:
+        ap.error("run dir required (or --selftest)")
+    report = collect(args.run_dir, metrics_file=args.metrics_file)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

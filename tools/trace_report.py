@@ -175,6 +175,12 @@ def _selftest() -> int:
                    "ts": t, "dur": 500.0})
     events.append({"name": "ckpt_write", "ph": "X", "pid": 1, "tid": 2,
                    "ts": t + 500.0, "dur": 1500.0})
+    # serving spans (serving/engine.py) ride the same timeline/report
+    events.append({"name": "prefill", "ph": "X", "pid": 1, "tid": 1,
+                   "ts": t + 2000.0, "dur": 800.0,
+                   "args": {"rid": 0, "bucket": 16}})
+    events.append({"name": "decode_step", "ph": "X", "pid": 1, "tid": 1,
+                   "ts": t + 2800.0, "dur": 300.0, "args": {"active": 2}})
     events.append({"name": "recompile", "ph": "i", "s": "t", "pid": 1,
                    "tid": 1, "ts": t, "args": {"fn": "train_step"}})
     events.append({"name": "telemetry/recompiles", "ph": "C", "pid": 1,
@@ -186,8 +192,10 @@ def _selftest() -> int:
         summary = summarize(load_events(path))
         text = render(summary)
     by_name = {r["name"]: r for r in summary["spans"]}
-    assert len(by_name) == 6, by_name.keys()
+    assert len(by_name) == 8, by_name.keys()
     assert by_name["forward"]["count"] == 3
+    assert by_name["prefill"]["count"] == 1
+    assert abs(by_name["decode_step"]["total_ms"] - 0.3) < 1e-9
     assert abs(by_name["forward"]["total_ms"] - 12.0) < 1e-9
     assert abs(by_name["optimizer_step"]["mean_ms"] - 2.0) < 1e-9
     assert summary["counters"]["telemetry/recompiles"] == 1.0
