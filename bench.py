@@ -307,6 +307,15 @@ def bench_serving(n_requests=12):
             srv.mean_occupancy)
 
 
+def _section_rows(result, name, **rows):
+    """Record one section's metric rows under ``result["sections"]`` — the
+    schema ``tools/bench_gate.py`` compares against the committed
+    baseline (the flat top-level keys stay for the driver's one-line
+    record; this block is the gate's contract)."""
+    result.setdefault("sections", {})[name] = {
+        k: v for k, v in rows.items() if v is not None}
+
+
 def _flush_partial(result):
     try:
         tmp = PARTIAL_PATH + ".tmp"
@@ -431,6 +440,11 @@ def main():
         # a future fleet-on BENCH round must record its fleet block here
         # so rows stay attributable.
         "fleet": "off",
+        # Device-time observatory (telemetry/devicetime.py) off: no
+        # scheduled jax.profiler captures perturb the timed windows; a
+        # future BENCH round capturing mid-bench must record its
+        # devicetime block here so rows stay attributable.
+        "devicetime": "off",
         # Memory observatory (telemetry/memory.py) off: no per-step
         # headroom gauges and no attribution AOT compile in the timed
         # windows. Per-round peak headroom is still recorded under
@@ -478,6 +492,8 @@ def main():
         # median-of-windows companion (ADVICE r3): drift-inclusive view of
         # the same run; `value`/`vs_baseline` stay best-of-windows.
         result["value_median_window"] = round(sps128_med, 2)
+        _section_rows(result, "bert128", samples_per_sec=result["value"],
+                      tflops=result["tflops"], mfu=result["mfu"])
 
     def sec_bert512():
         t0 = time.time()
@@ -493,6 +509,9 @@ def main():
         result["bert_seq512_vs_baseline"] = round(
             sps512 / BASELINE_BERT_SEQ512, 4)
         result["bert_seq512_median_window"] = round(sps512_med, 2)
+        _section_rows(result, "bert512",
+                      samples_per_sec=result["bert_seq512_samples_per_sec"],
+                      mfu=round(mfu512, 4))
 
     def sec_gpt2():
         t0 = time.time()
@@ -507,6 +526,9 @@ def main():
         result["gpt2_vs_baseline"] = round(gpt2_tps / BASELINE_GPT2_TOKENS, 4)
         result["gpt2_median_window"] = round(gpt2_tps_med, 0)
         result["gpt2_mfu"] = round(gpt2_mfu, 4)
+        _section_rows(result, "gpt2",
+                      tokens_per_sec=result["gpt2_tokens_per_sec"],
+                      mfu=result["gpt2_mfu"])
 
     def sec_gpt2_dropout():
         # Dropout-on variant (r2 VERDICT task 4 "done" criterion): real
@@ -521,6 +543,9 @@ def main():
             f"{do_mfu:.1%} ({time.time() - t0:.0f}s)")
         result["gpt2_dropout_tokens_per_sec"] = round(gpt2_do_tps, 0)
         result["gpt2_dropout_mfu"] = round(do_mfu, 4)
+        _section_rows(result, "gpt2_dropout",
+                      tokens_per_sec=result["gpt2_dropout_tokens_per_sec"],
+                      mfu=result["gpt2_dropout_mfu"])
 
     def sec_long():
         t0 = time.time()
@@ -534,6 +559,12 @@ def main():
         result["gpt2_seq16k_bigbird_tokens_per_sec"] = round(long_sparse, 0)
         result["gpt2_seq16k_sparse_speedup"] = round(
             long_sparse / long_dense, 3)
+        _section_rows(
+            result, "long16k",
+            dense_tokens_per_sec=result["gpt2_seq16k_dense_tokens_per_sec"],
+            bigbird_tokens_per_sec=result[
+                "gpt2_seq16k_bigbird_tokens_per_sec"],
+            sparse_speedup=result["gpt2_seq16k_sparse_speedup"])
 
     def sec_inference():
         t0 = time.time()
@@ -545,6 +576,10 @@ def main():
             f"b1 {tps1:.1f} tok/s, b8 {tps8:.1f} tok/s "
             f"({time.time() - t0:.0f}s)")
         result["gpt2_generate_b8_tokens_per_sec"] = round(tps8, 1)
+        _section_rows(
+            result, "inference",
+            b1_tokens_per_sec=result["gpt2_generate_b1_tokens_per_sec"],
+            b8_tokens_per_sec=result["gpt2_generate_b8_tokens_per_sec"])
 
     def sec_serving():
         # Continuous-batching serving row (tiny GPT, CPU-runnable): the
@@ -558,6 +593,11 @@ def main():
         result["serving_ttft_p50_ms"] = round(p50, 2)
         result["serving_ttft_p99_ms"] = round(p99, 2)
         result["serving_mean_occupancy"] = round(occ, 4)
+        _section_rows(result, "serving",
+                      tokens_per_sec=result["serving_tokens_per_sec"],
+                      ttft_p50_ms=result["serving_ttft_p50_ms"],
+                      ttft_p99_ms=result["serving_ttft_p99_ms"],
+                      mean_occupancy=result["serving_mean_occupancy"])
 
     sections = [("bert128", sec_bert128)]
     if on_tpu:

@@ -649,6 +649,79 @@ class TelemetryMemoryConfig:
 
 
 @dataclass
+class TelemetryDevicetimeConfig:
+    """Device-time observatory knobs (telemetry/devicetime.py): scheduled
+    ``jax.profiler`` captures (``capture_steps`` steps every
+    ``every_steps``, host-scoped dirs, keep-last-``keep_last`` GC) parsed
+    into measured ``devicetime/*`` attribution, roofline classification
+    and ``comm/measured_exposed_frac``. Default off — enabled, all work
+    happens at capture boundaries; the in-between step path pays two
+    integer comparisons and the step jaxpr never changes."""
+
+    enabled: bool = C.TELEMETRY_DEVICETIME_ENABLED_DEFAULT
+    capture_steps: int = C.TELEMETRY_DEVICETIME_CAPTURE_STEPS_DEFAULT
+    every_steps: int = C.TELEMETRY_DEVICETIME_EVERY_STEPS_DEFAULT
+    keep_last: int = C.TELEMETRY_DEVICETIME_KEEP_LAST_DEFAULT
+    dir: str = C.TELEMETRY_DEVICETIME_DIR_DEFAULT
+    top_k: int = C.TELEMETRY_DEVICETIME_TOP_K_DEFAULT
+    divergence_warn: float = C.TELEMETRY_DEVICETIME_DIVERGENCE_WARN_DEFAULT
+    hbm_gbps: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> \
+            "TelemetryDevicetimeConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.TELEMETRY_DEVICETIME_ENABLED,
+                              C.TELEMETRY_DEVICETIME_ENABLED_DEFAULT)),
+            capture_steps=int(_get(
+                d, C.TELEMETRY_DEVICETIME_CAPTURE_STEPS,
+                C.TELEMETRY_DEVICETIME_CAPTURE_STEPS_DEFAULT)),
+            every_steps=int(_get(
+                d, C.TELEMETRY_DEVICETIME_EVERY_STEPS,
+                C.TELEMETRY_DEVICETIME_EVERY_STEPS_DEFAULT)),
+            keep_last=int(_get(d, C.TELEMETRY_DEVICETIME_KEEP_LAST,
+                               C.TELEMETRY_DEVICETIME_KEEP_LAST_DEFAULT)),
+            dir=str(_get(d, C.TELEMETRY_DEVICETIME_DIR,
+                         C.TELEMETRY_DEVICETIME_DIR_DEFAULT)),
+            top_k=int(_get(d, C.TELEMETRY_DEVICETIME_TOP_K,
+                           C.TELEMETRY_DEVICETIME_TOP_K_DEFAULT)),
+            divergence_warn=float(_get(
+                d, C.TELEMETRY_DEVICETIME_DIVERGENCE_WARN,
+                C.TELEMETRY_DEVICETIME_DIVERGENCE_WARN_DEFAULT)),
+            hbm_gbps=(float(d[C.TELEMETRY_DEVICETIME_HBM_GBPS])
+                      if d.get(C.TELEMETRY_DEVICETIME_HBM_GBPS) is not None
+                      else None),
+        )
+        if cfg.capture_steps < 1:
+            raise ConfigError(
+                f"telemetry.devicetime.capture_steps must be >= 1, got "
+                f"{cfg.capture_steps}")
+        if cfg.every_steps <= cfg.capture_steps:
+            raise ConfigError(
+                f"telemetry.devicetime needs every_steps > capture_steps "
+                f"(a capture must close before the next can open), got "
+                f"every_steps={cfg.every_steps} "
+                f"capture_steps={cfg.capture_steps}")
+        if cfg.keep_last < 1:
+            raise ConfigError(
+                f"telemetry.devicetime.keep_last must be >= 1, got "
+                f"{cfg.keep_last}")
+        if cfg.top_k < 1:
+            raise ConfigError(
+                f"telemetry.devicetime.top_k must be >= 1, got {cfg.top_k}")
+        if not (0.0 < cfg.divergence_warn <= 1.0):
+            raise ConfigError(
+                f"telemetry.devicetime.divergence_warn must be in (0, 1], "
+                f"got {cfg.divergence_warn}")
+        if cfg.hbm_gbps is not None and cfg.hbm_gbps <= 0:
+            raise ConfigError(
+                f"telemetry.devicetime.hbm_gbps must be positive, got "
+                f"{cfg.hbm_gbps}")
+        return cfg
+
+
+@dataclass
 class TelemetryConfig:
     """Unified observability (telemetry/; docs/OBSERVABILITY.md): metrics
     registry + Chrome-trace step tracer + recompilation detector. Disabled
@@ -672,6 +745,11 @@ class TelemetryConfig:
     # capacity planner, OOM forensics. Opt-in (adds one AOT compile).
     memory: TelemetryMemoryConfig = field(
         default_factory=TelemetryMemoryConfig)
+    # Device-time observatory (telemetry/devicetime.py): scheduled
+    # jax.profiler captures -> measured op-level attribution, roofline,
+    # measured exposed-comm. Opt-in (profiler work at capture boundaries).
+    devicetime: TelemetryDevicetimeConfig = field(
+        default_factory=TelemetryDevicetimeConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
@@ -689,6 +767,8 @@ class TelemetryConfig:
             fleet=TelemetryFleetConfig.from_dict(d.get(C.TELEMETRY_FLEET)),
             memory=TelemetryMemoryConfig.from_dict(
                 d.get(C.TELEMETRY_MEMORY)),
+            devicetime=TelemetryDevicetimeConfig.from_dict(
+                d.get(C.TELEMETRY_DEVICETIME)),
         )
         if cfg.enabled and not cfg.dir:
             raise ConfigError(
@@ -698,6 +778,12 @@ class TelemetryConfig:
             raise ConfigError(
                 "telemetry.fleet requires telemetry.goodput (fleet "
                 "aggregation reads the goodput accountant's deltas)")
+        if cfg.devicetime.enabled and cfg.trace.jax_profiler_dir:
+            raise ConfigError(
+                "telemetry.devicetime and telemetry.trace.jax_profiler_dir "
+                "are mutually exclusive: the passthrough holds THE one "
+                "jax.profiler session open for the whole run, so scheduled "
+                "captures could never start")
         return cfg
 
 

@@ -219,6 +219,28 @@ TELEMETRY_MEMORY_HBM_LIMIT_GB = "hbm_limit_gb"
 # deterministic OOM is a config bug, and a hot restart loop would just
 # re-OOM until the budget is gone.
 MEMORY_OOM_EXIT_CODE_DEFAULT = 114
+# Device-time observatory (telemetry/devicetime.py): scheduled
+# jax.profiler captures parsed into measured op-level attribution,
+# roofline classification and measured exposed-comm. Default OFF:
+# enabled it adds profiler start/stop + one device drain + a parse at
+# capture boundaries (never on the in-between step path) — explicit
+# opt-in like fleet/memory.
+TELEMETRY_DEVICETIME = "devicetime"
+TELEMETRY_DEVICETIME_ENABLED = "enabled"
+TELEMETRY_DEVICETIME_ENABLED_DEFAULT = False
+TELEMETRY_DEVICETIME_CAPTURE_STEPS = "capture_steps"
+TELEMETRY_DEVICETIME_CAPTURE_STEPS_DEFAULT = 3    # steps per capture
+TELEMETRY_DEVICETIME_EVERY_STEPS = "every_steps"
+TELEMETRY_DEVICETIME_EVERY_STEPS_DEFAULT = 200    # capture cadence
+TELEMETRY_DEVICETIME_KEEP_LAST = "keep_last"
+TELEMETRY_DEVICETIME_KEEP_LAST_DEFAULT = 2        # capture-dir GC
+TELEMETRY_DEVICETIME_DIR = "dir"
+TELEMETRY_DEVICETIME_DIR_DEFAULT = "devicetime"   # under telemetry.dir
+TELEMETRY_DEVICETIME_TOP_K = "top_k"
+TELEMETRY_DEVICETIME_TOP_K_DEFAULT = 10           # hottest-op table rows
+TELEMETRY_DEVICETIME_DIVERGENCE_WARN = "divergence_warn"
+TELEMETRY_DEVICETIME_DIVERGENCE_WARN_DEFAULT = 0.25  # |measured-modeled|
+TELEMETRY_DEVICETIME_HBM_GBPS = "hbm_gbps"        # None -> per-kind table
 
 #############################################
 # Serving (TPU-native block, no reference analogue: continuous-batching

@@ -39,6 +39,26 @@ TPU_PEAK_TFLOPS = {
 }
 DEFAULT_PEAK_TFLOPS = 197.0  # v5e-class bf16 — the conservative fallback
 
+# Peak HBM bandwidth per chip kind (GB/s) — the denominator of the
+# roofline ridge point (telemetry/devicetime.py): ridge [flop/byte] =
+# peak_flops / peak_bytes_per_sec. Published chip numbers; the fallback
+# is v5e-class like DEFAULT_PEAK_TFLOPS.
+TPU_PEAK_HBM_GBPS = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1638.0,
+    "TPU v6e": 1638.0,
+}
+DEFAULT_PEAK_HBM_GBPS = 819.0
+
+
+def peak_hbm_gbps(device_kind: Optional[str] = None) -> float:
+    """Per-chip peak HBM bandwidth (GB/s) with the conservative
+    v5e-class default for unknown kinds (CPU test meshes, future
+    chips)."""
+    return TPU_PEAK_HBM_GBPS.get(device_kind or "", DEFAULT_PEAK_HBM_GBPS)
+
 _DTYPE_ALIASES = {
     "bf16": "bfloat16", "bfloat16": "bfloat16",
     "fp32": "float32", "float32": "float32",

@@ -344,6 +344,14 @@ class TPUEngine:
         from deepspeed_tpu.telemetry.memory import build_memory_observatory
         self.memory = build_memory_observatory(
             config.telemetry, telemetry=self.telemetry, goodput=self.goodput)
+        # Device-time observatory (telemetry/devicetime.py): scheduled
+        # jax.profiler captures parsed into measured devicetime/* op
+        # attribution, roofline verdicts and comm/measured_exposed_frac.
+        # Disabled (the default) => None, the hook one attribute check;
+        # enabled, profiler work happens only at capture boundaries.
+        from deepspeed_tpu.telemetry.devicetime import build_devicetime
+        self.devicetime = build_devicetime(
+            config.telemetry, telemetry=self.telemetry, goodput=self.goodput)
         if self.memory is not None:
             # Pre-compile: ledger gauges + the stage×offload×microbatch
             # what-if table (loud warning when the chosen config projects
@@ -1535,6 +1543,11 @@ class TPUEngine:
             self._emit_comm_attribution(tel)
         if self.goodput is not None:
             self.goodput.emit(self.global_steps)
+        if self.devicetime is not None:
+            # Capture scheduler: two int compares in steady state; opens/
+            # closes a jax.profiler capture (and parses it into the
+            # devicetime/* gauges) only at its configured boundaries.
+            self.devicetime.step_hook(self.global_steps)
         if self.global_steps % self.steps_per_print == 0:
             tel.flush()
             if self.goodput is not None:
@@ -1609,7 +1622,11 @@ class TPUEngine:
             g.set_flops(flops, n_chips=self.mesh.size,
                         peak_tflops_per_chip=peak_tflops(
                             getattr(dev, "device_kind", ""),
-                            dtype=self.precision.name))
+                            dtype=self.precision.name),
+                        # bytes feed the devicetime roofline's operational
+                        # intensity (telemetry/devicetime.py)
+                        bytes_per_step=float(
+                            cost.get("bytes accessed", 0.0)))
         except Exception as e:  # noqa: BLE001 — MFU is best-effort
             g.flops_failed()
             logger.warning("goodput: step cost analysis unavailable: %s", e)

@@ -18,6 +18,9 @@ sites never branch on "is telemetry on".
 import os
 from typing import Optional
 
+from deepspeed_tpu.telemetry.devicetime import (DEVICETIME_METRIC_TAGS,
+                                                DeviceTimeObservatory,
+                                                build_devicetime)
 from deepspeed_tpu.telemetry.fleet import (FLEET_METRIC_TAGS, FleetAggregator,
                                            build_fleet, default_host,
                                            host_scoped_path,
@@ -42,13 +45,14 @@ from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram,
 from deepspeed_tpu.telemetry.tracer import StepTracer
 
 __all__ = [
-    "Counter", "FLEET_METRIC_TAGS", "FleetAggregator", "Gauge",
+    "Counter", "DEVICETIME_METRIC_TAGS", "DeviceTimeObservatory",
+    "FLEET_METRIC_TAGS", "FleetAggregator", "Gauge",
     "GOODPUT_CATEGORIES", "GOODPUT_METRIC_TAGS", "GoodputAccountant",
     "Histogram", "InMemorySink", "JSONLSink", "MEMORY_METRIC_TAGS",
     "MemoryObservatory", "MetricsRegistry",
     "RecompileDetector", "RECOMPILE_COUNTER", "Sink", "StepTracer",
-    "Telemetry", "TensorboardSink", "build_fleet", "build_goodput",
-    "build_memory_observatory", "build_telemetry",
+    "Telemetry", "TensorboardSink", "build_devicetime", "build_fleet",
+    "build_goodput", "build_memory_observatory", "build_telemetry",
     "collect_memory_snapshot", "default_host", "host_scoped_path",
     "model_state_ledger", "null_telemetry", "plan_capacity",
     "telemetry_host_component", "tree_signature",

@@ -222,6 +222,7 @@ class GoodputAccountant:
         self._last_step_dt: Optional[float] = None
         # MFU inputs: set once per compiled step fn by the engine.
         self._flops_per_step: Optional[float] = None
+        self._bytes_per_step: Optional[float] = None
         self._n_chips = 1
         self._peak_tflops: Optional[float] = None
         self._flops_attempted = False
@@ -296,15 +297,32 @@ class GoodputAccountant:
         self._flops_attempted = True
 
     def set_flops(self, flops_per_step: float, n_chips: int = 1,
-                  peak_tflops_per_chip: Optional[float] = None) -> None:
-        """FLOPs of ONE compiled global step (XLA cost_analysis), the chip
-        count it ran across, and the per-chip peak — set once per compiled
-        step function by the engine."""
+                  peak_tflops_per_chip: Optional[float] = None,
+                  bytes_per_step: Optional[float] = None) -> None:
+        """FLOPs (and, when known, bytes accessed) of ONE compiled global
+        step (XLA cost_analysis), the chip count it ran across, and the
+        per-chip peak — set once per compiled step function by the
+        engine. ``bytes_per_step`` feeds the device-time observatory's
+        roofline classification (telemetry/devicetime.py)."""
         self._flops_attempted = True
         if flops_per_step and flops_per_step > 0:
             self._flops_per_step = float(flops_per_step)
             self._n_chips = max(int(n_chips), 1)
             self._peak_tflops = peak_tflops_per_chip
+            if bytes_per_step and bytes_per_step > 0:
+                self._bytes_per_step = float(bytes_per_step)
+
+    def flops_info(self) -> Optional[Dict[str, Any]]:
+        """The cost-analysis record :meth:`set_flops` captured (None until
+        the engine has fed it): flops / bytes accessed per step, chip
+        count, per-chip peak — the device-time observatory's roofline and
+        measured-MFU inputs."""
+        if self._flops_per_step is None:
+            return None
+        return {"flops_per_step": self._flops_per_step,
+                "bytes_per_step": self._bytes_per_step,
+                "n_chips": self._n_chips,
+                "peak_tflops_per_chip": self._peak_tflops}
 
     def mean_step_time(self) -> Optional[float]:
         with self._lock:

@@ -114,6 +114,7 @@ class StepTracer:
         self._epoch_wall = time.time()
         self._pid = os.getpid()
         self._profiler_active = False
+        self._profiler_dir: Optional[str] = None
         self._atexit_registered = False
         if self.enabled:
             self._meta("process_name", {"name": "deepspeed_tpu"})
@@ -186,14 +187,42 @@ class StepTracer:
             return {e["name"] for e in self._events if e.get("ph") == "X"}
 
     # -- jax.profiler passthrough --------------------------------------
-    def start_jax_profiler(self) -> None:
-        if self._profiler_active or not self.jax_profiler_dir:
-            return
+    @property
+    def profiler_active(self) -> bool:
+        return self._profiler_active
+
+    @staticmethod
+    def host_scoped_profile_dir(target: str) -> str:
+        """Multi-host capture dirs must not collide on shared storage:
+        whenever the run spans processes (or ``DSTPU_TELEMETRY_HOST``
+        forces it) the capture lands in a per-host subdir — the same
+        convention that host-scopes ``metrics.<host>.jsonl``. Single-host
+        paths come back unchanged."""
+        try:
+            from deepspeed_tpu.telemetry.fleet import \
+                telemetry_host_component
+            part = telemetry_host_component()
+        except Exception:  # noqa: BLE001 — backendless: single-host
+            part = None
+        return os.path.join(target, part) if part else target
+
+    def start_jax_profiler(self, dir: Optional[str] = None) -> \
+            Optional[str]:
+        """Start a ``jax.profiler`` capture into ``dir`` (the device-time
+        observatory's scheduled captures) or the configured passthrough
+        ``jax_profiler_dir``. Returns the host-scoped directory actually
+        captured into, or None (already active / no dir / profiler
+        unavailable)."""
+        target = dir or self.jax_profiler_dir
+        if self._profiler_active or not target:
+            return None
         try:
             import jax
-            os.makedirs(self.jax_profiler_dir, exist_ok=True)
-            jax.profiler.start_trace(self.jax_profiler_dir)
+            target = self.host_scoped_profile_dir(target)
+            os.makedirs(target, exist_ok=True)
+            jax.profiler.start_trace(target)
             self._profiler_active = True
+            self._profiler_dir = target
             # Guarantee stop_trace even when a crash skips close(): an
             # exception between start and stop otherwise leaks the
             # profiler session (and its capture buffer) for the rest of
@@ -203,19 +232,26 @@ class StepTracer:
                 import atexit
                 atexit.register(self.stop_jax_profiler)
                 self._atexit_registered = True
+            return target
         except Exception as e:  # noqa: BLE001 — profiler is best-effort
             from deepspeed_tpu.utils.logging import logger
             logger.warning("jax.profiler passthrough unavailable: %s", e)
+            return None
 
-    def stop_jax_profiler(self) -> None:
+    def stop_jax_profiler(self) -> Optional[str]:
+        """Stop the active capture (idempotent). Returns the directory it
+        was writing into, or None when nothing was active."""
         if not self._profiler_active:
-            return
+            return None
         try:
             import jax
             jax.profiler.stop_trace()
         except Exception:  # noqa: BLE001
             pass
         self._profiler_active = False
+        d = getattr(self, "_profiler_dir", None)
+        self._profiler_dir = None
+        return d
 
     # -- persistence ----------------------------------------------------
     def save(self) -> Optional[str]:

@@ -1,0 +1,638 @@
+"""Device-time observatory tests (telemetry/devicetime.py +
+telemetry/traceparse.py; docs/OBSERVABILITY.md "Device-time
+observatory"): the shared parser's category mapping and overlap/
+exposed-comm math on synthetic gzip perfetto fixtures (multi-device
+streams, torn/empty captures tolerated), the production capture
+scheduler driving REAL jax.profiler captures on the CPU backend into
+nonzero devicetime/* gauges + a top-K table + keep-last GC, the
+measured-vs-modeled exposed-comm cross-check on a 2-slice mesh, the
+divergence warning, the zero-sync + bit-identical-step disabled
+contract, StepTracer host-scoped capture dirs, and the
+devicetime_report / bench_gate selftests (tier-1)."""
+
+import gzip
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config.config import ConfigError, DeepSpeedTPUConfig
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.telemetry import (InMemorySink, MetricsRegistry,
+                                     StepTracer, Telemetry, traceparse)
+from deepspeed_tpu.telemetry.devicetime import (DEVICETIME_METRIC_TAGS,
+                                                DIVERGENCE_INSTANT,
+                                                DeviceTimeObservatory,
+                                                build_devicetime,
+                                                roofline_verdicts)
+from deepspeed_tpu.telemetry.recompile import RecompileDetector
+
+from simple_model import mlp_loss_fn, mlp_params, random_batches
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_profiler_session():
+    """jax.profiler is a process-wide singleton: a test that ends with a
+    scheduled capture still open would starve every later test's capture.
+    Always drain it."""
+    yield
+    try:
+        jax.profiler.stop_trace()
+    except Exception:  # noqa: BLE001 — nothing was active
+        pass
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _engine(config_extra=None, mesh=None):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn, params=mlp_params(),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 1},
+                **(config_extra or {})},
+        mesh=mesh if mesh is not None else build_mesh(data=8))
+    return engine
+
+
+def _tel_cfg(tmp_path, devicetime=None, trace=False, extra=None):
+    tel = {"enabled": True, "dir": str(tmp_path),
+           "trace": {"enabled": trace},
+           "metrics": {"sinks": ["memory"]}}
+    if devicetime is not None:
+        tel["devicetime"] = devicetime
+    return {"telemetry": tel, "steps_per_print": 1, **(extra or {})}
+
+
+def _fast_devicetime(**over):
+    return {"enabled": True, "capture_steps": 1, "every_steps": 2,
+            "keep_last": 1, **over}
+
+
+def _write_capture(dirpath, events, name="host.trace.json.gz"):
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, name)
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def _x(name, pid, tid, ts_ms, dur_ms):
+    return {"name": name, "ph": "X", "pid": pid, "tid": tid,
+            "ts": ts_ms * 1e3, "dur": dur_ms * 1e3}
+
+
+def _proc(pid, name):
+    return {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name}}
+
+
+# ---------------------------------------------------------------------------
+# The shared parser: category mapping + overlap math on synthetic fixtures
+# ---------------------------------------------------------------------------
+class TestTraceparse:
+    def test_category_mapping(self):
+        cases = {
+            "dot.3": "matmul", "convolution.1": "matmul",
+            "dot-general": "matmul",
+            "fusion.12": "elementwise", "loop_fusion": "elementwise",
+            "reduce.8": "elementwise", "reduce-window": "elementwise",
+            "convert.5": "elementwise", "add.1": "elementwise",
+            "all-reduce.63": "collective", "all-gather.96": "collective",
+            "reduce-scatter.2": "collective", "all-to-all": "collective",
+            "collective-permute.1": "collective",
+            "all-reduce-start.4": "collective",
+            "copy.2": "copy", "transpose.9": "copy", "bitcast.1": "copy",
+            "dynamic-update-slice.3": "copy",
+            "custom-call.4": "other",
+        }
+        for name, want in cases.items():
+            assert traceparse.classify_op(name) == want, name
+        # runtime/host scaffolding is never attributed
+        for noise in ("ThreadpoolListener::StartRegion",
+                      "TfrtCpuExecutable::Execute",
+                      "PjitFunction(<lambda>)", "ParseArguments",
+                      "$profiler.py:91 start_trace", ""):
+            assert traceparse.classify_op(noise) is None, noise
+
+    def test_overlap_and_exposed_math_exact(self, tmp_path):
+        """compute [0,10ms] on one stream, collective [5,15ms] on another
+        -> 10ms collective of which 5ms exposed; busy = union = 15ms."""
+        events = [
+            _proc(1, "/device:TPU:0"),
+            _x("dot.1", 1, 1, 0.0, 10.0),
+            _x("all-reduce.7", 1, 2, 5.0, 10.0),
+        ]
+        _write_capture(str(tmp_path), events)
+        a = traceparse.parse_capture_dir(str(tmp_path))
+        assert abs(a["categories"]["matmul"] - 0.010) < 1e-12
+        assert abs(a["collective_sec"] - 0.010) < 1e-12
+        assert abs(a["exposed_collective_sec"] - 0.005) < 1e-12
+        assert abs(a["busy_sec"] - 0.015) < 1e-12
+        assert abs(a["window_sec"] - 0.015) < 1e-12
+        assert a["gap_sec"] < 1e-12
+        assert a["n_devices"] == 1
+
+    def test_exposed_uses_interval_union(self, tmp_path):
+        """N streams running the SAME collective concurrently (the CPU
+        backend's one-process-many-shards layout) must count the wall
+        time once: 8 copies of [0,10ms] with compute over [0,4ms] ->
+        6ms exposed, not 48."""
+        events = [_proc(1, "/device:TPU:0"), _x("dot.1", 1, 99, 0.0, 4.0)]
+        for tid in range(8):
+            events.append(_x("all-reduce.1", 1, tid, 0.0, 10.0))
+        _write_capture(str(tmp_path), events)
+        a = traceparse.parse_capture_dir(str(tmp_path))
+        assert abs(a["exposed_collective_sec"] - 0.006) < 1e-12
+        # category seconds stay device-second sums (8 x 10ms)
+        assert abs(a["categories"]["collective"] - 0.080) < 1e-12
+        window = a["window_sec"]
+        assert a["exposed_collective_sec"] <= window + 1e-12
+
+    def test_multi_device_streams_and_host_exclusion(self, tmp_path):
+        """Two device pids aggregate busy/window/gap; the /host: pid's
+        HLO-looking events are excluded when device rows exist."""
+        events = [
+            _proc(1, "/device:TPU:0"), _proc(2, "/device:TPU:1"),
+            _proc(9, "/host:CPU"),
+            _x("fusion.1", 1, 1, 0.0, 2.0),
+            _x("fusion.2", 1, 1, 5.0, 1.0),      # 3ms gap on dev0
+            _x("dot.1", 2, 1, 0.0, 4.0),
+            _x("dot.99", 9, 1, 0.0, 100.0),      # host: ignored
+        ]
+        _write_capture(str(tmp_path), events)
+        a = traceparse.parse_capture_dir(str(tmp_path))
+        assert a["n_devices"] == 2
+        assert abs(a["busy_sec"] - 0.007) < 1e-12
+        assert abs(a["window_sec"] - 0.010) < 1e-12
+        assert abs(a["gap_sec"] - 0.003) < 1e-12
+        assert abs(a["categories"]["matmul"] - 0.004) < 1e-12
+        names = set(a["ops"])
+        assert "dot.99" not in names
+
+    def test_torn_and_empty_captures_tolerated(self, tmp_path):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        a = traceparse.parse_capture_dir(str(empty))
+        assert a["n_devices"] == 0 and a["busy_sec"] == 0.0
+        assert a["captures"] == []
+        # torn gzip + valid capture side by side: torn skipped
+        torn_dir = tmp_path / "torn"
+        torn_dir.mkdir()
+        with open(torn_dir / "x.trace.json.gz", "wb") as f:
+            f.write(b"\x1f\x8b\x08\x00garbage-not-gzip")
+        _write_capture(str(torn_dir),
+                       [_x("dot.1", 1, 1, 0.0, 1.0)],
+                       name="ok.trace.json.gz")
+        a = traceparse.parse_capture_dir(str(torn_dir))
+        assert len(a["captures"]) == 1
+        assert abs(a["categories"]["matmul"] - 0.001) < 1e-12
+
+    def test_top_ops_table(self, tmp_path):
+        events = [_x("dot.1", 1, 1, 0.0, 8.0),
+                  _x("fusion.2", 1, 1, 8.0, 2.0),
+                  _x("dot.1", 1, 1, 10.0, 8.0)]
+        _write_capture(str(tmp_path), events)
+        a = traceparse.parse_capture_dir(str(tmp_path))
+        hot = traceparse.top_ops(a, 1)
+        assert len(hot) == 1
+        assert hot[0]["name"] == "dot.1" and hot[0]["count"] == 2
+        assert abs(hot[0]["sec"] - 0.016) < 1e-12
+        assert hot[0]["share_of_busy"] > 0.8
+
+    def test_scan_profile_dir_legacy_semantics(self, tmp_path):
+        """fleet_report --profile-dir output is unchanged by the
+        consolidation: total sums ALL duration events (runtime noise
+        included), collective by the shared regex."""
+        events = [_x("all-reduce.1", 1, 1, 0.0, 3.0),
+                  _x("dot.1", 1, 1, 3.0, 6.0),
+                  {"name": "ThunkExecutor::Execute", "ph": "X", "pid": 1,
+                   "tid": 2, "ts": 0.0, "dur": 1_000.0}]
+        _write_capture(str(tmp_path / "plugins"), events)
+        fr = _load_tool("fleet_report")
+        out = fr.scan_profile_dir(str(tmp_path))
+        (rel, row), = out.items()
+        assert rel.endswith("host.trace.json.gz")
+        assert abs(row["collective_ms"] - 3.0) < 1e-9
+        assert abs(row["total_ms"] - 10.0) < 1e-9
+        assert abs(row["collective_frac"] - 0.3) < 1e-9
+
+    def test_one_collective_list_in_tree(self):
+        """THE collective-op-name list lives in traceparse; fleet_report
+        re-binds it (satellite: one list in the tree)."""
+        fr = _load_tool("fleet_report")
+        assert fr.COLLECTIVE_RE is not None
+        assert fr.COLLECTIVE_RE.pattern == traceparse.COLLECTIVE_RE.pattern
+
+
+# ---------------------------------------------------------------------------
+# Capture scheduler on the real CPU backend (acceptance: a real capture
+# round-trips into nonzero devicetime/* gauges + a top-K table)
+# ---------------------------------------------------------------------------
+class TestScheduler:
+    def test_real_capture_roundtrip_nonzero_gauges(self, eight_devices,
+                                                   tmp_path):
+        engine = _engine(_tel_cfg(tmp_path,
+                                  devicetime=_fast_devicetime(top_k=5)))
+        assert engine.devicetime is not None
+        rng = np.random.default_rng(0)
+        batches = random_batches(rng, gas=1, batch_size=16)
+        for _ in range(5):
+            engine.train_batch(batches)
+        assert engine.devicetime.captures_done >= 1
+        mem = engine.telemetry.registry.sinks[0]
+        tags = mem.tags()
+        for tag in ("devicetime/busy_sec", "devicetime/window_sec",
+                    "devicetime/steps_captured",
+                    "comm/measured_exposed_frac"):
+            assert tag in tags, tag
+        assert mem.values("devicetime/busy_sec")[-1] > 0
+        assert mem.values("devicetime/window_sec")[-1] > 0
+        # a ZeRO-1 MLP step on the 8-device mesh has real matmuls and
+        # real collectives in the capture
+        assert (mem.values("devicetime/matmul_sec")[-1] > 0
+                or mem.values("devicetime/elementwise_sec")[-1] > 0)
+        assert mem.values("devicetime/collective_sec")[-1] > 0
+        frac = mem.values("comm/measured_exposed_frac")[-1]
+        assert 0.0 <= frac <= 1.0
+        # top-K hottest-op table in the breakdown artifact
+        bd = engine.devicetime.last_breakdown
+        assert bd is not None and bd["top_ops"]
+        assert all(r["sec"] > 0 for r in bd["top_ops"])
+        assert os.path.exists(engine.devicetime.breakdown_path)
+        doc = json.load(open(engine.devicetime.breakdown_path))
+        assert doc["steps_captured"] >= 1
+        assert set(doc["categories_sec"]) == set(traceparse.CATEGORIES)
+        # mfu_measured rides the cost-analysis join (engine feeds flops)
+        assert doc["mfu_measured"] is None or doc["mfu_measured"] > 0
+
+    def test_keep_last_gc(self, eight_devices, tmp_path):
+        engine = _engine(_tel_cfg(
+            tmp_path, devicetime=_fast_devicetime(keep_last=1)))
+        rng = np.random.default_rng(0)
+        batches = random_batches(rng, gas=1, batch_size=16)
+        # 9 steps: captures open at 2/4/6/8 and each closes on the next
+        # step, so the run ends with no capture in flight and the GC has
+        # run after every close.
+        for _ in range(9):
+            engine.train_batch(batches)
+        assert engine.devicetime.captures_done >= 2
+        cap_root = os.path.join(str(tmp_path), "devicetime")
+        dirs = [d for d in os.listdir(cap_root)
+                if d.startswith("capture_step")]
+        assert len(dirs) == 1, dirs
+
+    def test_report_tool_renders_engine_breakdown(self, eight_devices,
+                                                  tmp_path):
+        engine = _engine(_tel_cfg(tmp_path, devicetime=_fast_devicetime()))
+        rng = np.random.default_rng(0)
+        batches = random_batches(rng, gas=1, batch_size=16)
+        for _ in range(4):
+            engine.train_batch(batches)
+        assert engine.devicetime.captures_done >= 1
+        dr = _load_tool("devicetime_report")
+        breakdowns = dr.load_breakdowns(str(tmp_path))
+        assert len(breakdowns) == 1
+        text = dr.render_breakdown(breakdowns[0])
+        assert "collective" in text and "hottest ops" in text
+
+    def test_tracer_capture_dirs_host_scoped(self, tmp_path, monkeypatch):
+        """Satellite: start_jax_profiler lands in a per-host subdir
+        whenever the run spans processes (forced here via
+        DSTPU_TELEMETRY_HOST, the PR 6 convention), so multi-host
+        captures on shared storage never collide."""
+        started = {}
+        import jax.profiler as jprof
+        monkeypatch.setattr(jprof, "start_trace",
+                            lambda d: started.__setitem__("dir", d))
+        monkeypatch.setattr(jprof, "stop_trace", lambda: None)
+        monkeypatch.setenv("DSTPU_TELEMETRY_HOST", "worker-3")
+        tracer = StepTracer(enabled=False)
+        out = tracer.start_jax_profiler(dir=str(tmp_path / "cap"))
+        assert out == started["dir"]
+        assert os.path.basename(out) == "worker-3"
+        assert os.path.dirname(out) == str(tmp_path / "cap")
+        assert tracer.profiler_active
+        assert tracer.stop_jax_profiler() == out
+        assert not tracer.profiler_active
+        # single-host: path unchanged
+        monkeypatch.delenv("DSTPU_TELEMETRY_HOST")
+        out2 = tracer.start_jax_profiler(dir=str(tmp_path / "cap2"))
+        assert out2 == str(tmp_path / "cap2")
+
+    def test_divergence_warning_and_instant(self, tmp_path, monkeypatch):
+        """A measured exposed-comm fraction far from the modeled one must
+        warn loudly and drop the divergence instant."""
+        warnings = []
+        from deepspeed_tpu.telemetry import devicetime as dt_mod
+        monkeypatch.setattr(
+            dt_mod.logger, "warning",
+            lambda msg, *a, **k: warnings.append(msg % a if a else msg))
+        reg = MetricsRegistry()
+        reg.add_sink(InMemorySink())
+        tracer = StepTracer(path=str(tmp_path / "trace.json"))
+        tel = Telemetry(reg, tracer, RecompileDetector(enabled=False))
+        cfg = DeepSpeedTPUConfig(
+            {"train_micro_batch_size_per_gpu": 1,
+             "telemetry": {"enabled": True, "dir": str(tmp_path),
+                           "devicetime": {"enabled": True}}}
+        ).telemetry.devicetime
+        obs = DeviceTimeObservatory(cfg, run_dir=str(tmp_path),
+                                    telemetry=tel, host="h0")
+        reg.gauge("comm/exposed_frac").set(0.9, step=1)
+        analysis = traceparse.merge_analyses([])
+        analysis["categories"]["collective"] = 0.001
+        analysis["collective_sec"] = 0.001
+        analysis["exposed_collective_sec"] = 0.0
+        analysis["window_sec"] = 0.010
+        analysis["busy_sec"] = 0.010
+        analysis["n_devices"] = 1
+        obs._emit(analysis, step=1, steps_captured=1)
+        assert any("diverges" in w for w in warnings), warnings
+        assert DIVERGENCE_INSTANT in {e["name"] for e in tracer.events
+                                      if e.get("ph") == "i"}
+        # and the modeled value landed in the breakdown for the report
+        assert obs.last_breakdown["exposed_comm"]["modeled_frac"] == 0.9
+
+    def _obs(self, tmp_path, devicetime=None):
+        reg = MetricsRegistry()
+        mem = reg.add_sink(InMemorySink())
+        tracer = StepTracer(path=str(tmp_path / "trace.json"))
+        tel = Telemetry(reg, tracer, RecompileDetector(enabled=False))
+        cfg = DeepSpeedTPUConfig(
+            {"train_micro_batch_size_per_gpu": 1,
+             "telemetry": {"enabled": True, "dir": str(tmp_path),
+                           "devicetime": {"enabled": True,
+                                          **(devicetime or {})}}}
+        ).telemetry.devicetime
+        obs = DeviceTimeObservatory(cfg, run_dir=str(tmp_path),
+                                    telemetry=tel, host="h0")
+        return obs, tel, mem
+
+    def test_empty_capture_skips_emission_no_false_divergence(
+            self, tmp_path, monkeypatch):
+        """A capture that closes with no parseable device events must not
+        zero the gauges — and must not fire a spurious divergence
+        warning against a high modeled fraction."""
+        obs, tel, mem = self._obs(tmp_path, devicetime={
+            "capture_steps": 1, "every_steps": 2})
+        tel.registry.gauge("comm/exposed_frac").set(0.9, step=2)
+        import jax.profiler as jprof
+        monkeypatch.setattr(jprof, "start_trace", lambda d: None)
+        monkeypatch.setattr(jprof, "stop_trace", lambda: None)
+        obs._start_capture(2)
+        assert obs._capture_dir is not None
+        obs.step_hook(3)                       # closes: dir has no captures
+        assert obs.captures_done == 0
+        assert "comm/measured_exposed_frac" not in mem.tags()
+        assert not {t for t in mem.tags() if t.startswith("devicetime/")}
+        assert DIVERGENCE_INSTANT not in {e["name"] for e in
+                                          tel.tracer.events
+                                          if e.get("ph") == "i"}
+
+    def test_capture_dir_host_scoped_parse_and_gc(self, tmp_path,
+                                                  monkeypatch):
+        """Multi-host: the observatory parses and GCs only THIS host's
+        subdir of the shared per-step capture root (and drops the root
+        once empty) — never another host's capture."""
+        monkeypatch.setenv("DSTPU_TELEMETRY_HOST", "workerA")
+        obs, tel, mem = self._obs(tmp_path, devicetime={
+            "keep_last": 1, "capture_steps": 1, "every_steps": 2})
+        import jax.profiler as jprof
+        monkeypatch.setattr(jprof, "start_trace", lambda d: None)
+        monkeypatch.setattr(jprof, "stop_trace", lambda: None)
+
+        def run_capture(step, dur_ms):
+            obs._start_capture(step)
+            assert os.path.basename(obs._capture_dir) == "workerA"
+            # another host's capture lands beside ours in the same root
+            root = os.path.dirname(obs._capture_dir)
+            _write_capture(os.path.join(root, "workerB"),
+                           [_x("all-reduce.9", 1, 1, 0.0, 500.0)])
+            _write_capture(obs._capture_dir,
+                           [_x("dot.1", 1, 1, 0.0, dur_ms)])
+            obs.step_hook(step + 1)
+            return root
+
+        root1 = run_capture(2, 3.0)
+        assert obs.captures_done == 1
+        # only OUR host's events were parsed (no collective from workerB)
+        assert mem.values("devicetime/collective_sec")[-1] == 0.0
+        assert abs(mem.values("devicetime/matmul_sec")[-1] - 0.003) < 1e-12
+        root2 = run_capture(4, 5.0)
+        # keep_last=1: our subdir of root1 GC'd, workerB's untouched,
+        # root1 itself kept (still non-empty)
+        assert not os.path.exists(os.path.join(root1, "workerA"))
+        assert os.path.exists(os.path.join(root1, "workerB"))
+        assert os.path.exists(os.path.join(root2, "workerA"))
+
+    def test_roofline_verdicts(self):
+        v = roofline_verdicts(intensity=500.0, ridge=240.0)
+        assert v["matmul"] == "compute-bound"
+        v = roofline_verdicts(intensity=100.0, ridge=240.0)
+        assert v["matmul"] == "hbm-bound"
+        assert v["collective"] == "network-bound"
+        assert roofline_verdicts(None, 240.0)["matmul"] == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# Measured-vs-modeled cross-check on a 2-slice mesh (acceptance)
+# ---------------------------------------------------------------------------
+class TestExposedCrossCheck:
+    def test_measured_vs_modeled_on_two_slices(self, eight_devices,
+                                               tmp_path):
+        engine = _engine(
+            _tel_cfg(tmp_path, devicetime=_fast_devicetime(),
+                     extra={"gradient_accumulation_steps": 2,
+                            "comm": {"hierarchical": "on",
+                                     "dcn_quant_bits": 8},
+                            "zero_optimization": {"stage": 2}}),
+            mesh=build_mesh(slices=2))
+        rng = np.random.default_rng(0)
+        batches = random_batches(rng, gas=2, batch_size=16)
+        for _ in range(5):
+            engine.train_batch(batches)
+        assert engine.devicetime.captures_done >= 1
+        mem = engine.telemetry.registry.sinks[0]
+        modeled = mem.values("comm/exposed_frac")
+        measured = mem.values("comm/measured_exposed_frac")
+        assert modeled and measured, (mem.tags())
+        assert all(0.0 < f <= 1.0 for f in modeled)
+        assert all(0.0 <= f <= 1.0 for f in measured)
+        bd = engine.devicetime.last_breakdown
+        assert bd["exposed_comm"]["modeled_frac"] is not None
+        assert bd["exposed_comm"]["measured_frac"] is not None
+        # the hierarchical step's collectives are visible in the capture
+        assert bd["categories_sec"]["collective"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead disabled contract (the fleet/goodput/memory gate shape)
+# ---------------------------------------------------------------------------
+class TestDisabledContract:
+    def test_disabled_devicetime_is_none_no_tags_zero_syncs(
+            self, eight_devices, tmp_path, monkeypatch):
+        engine = _engine(_tel_cfg(tmp_path))  # telemetry on, devicetime off
+        assert engine.devicetime is None
+        batches = random_batches(np.random.default_rng(0), gas=1,
+                                 batch_size=16)
+        engine.train_batch(batches)           # compile outside the window
+        from deepspeed_tpu.utils import timer as timer_mod
+        calls = {"n": 0}
+        monkeypatch.setattr(timer_mod, "_device_synchronize",
+                            lambda: calls.__setitem__("n", calls["n"] + 1))
+        for _ in range(10):
+            engine.train_batch(batches)
+        assert calls["n"] == 0
+        mem = engine.telemetry.registry.sinks[0]
+        assert not {t for t in mem.tags()
+                    if t.startswith("devicetime/")
+                    or t == "comm/measured_exposed_frac"}
+        assert not os.path.exists(tmp_path / "devicetime")
+        # telemetry fully off too
+        engine2 = _engine()
+        assert engine2.devicetime is None
+
+    def test_enabled_between_captures_zero_syncs(self, eight_devices,
+                                                 tmp_path, monkeypatch):
+        """Enabled devicetime must only touch the device at capture
+        boundaries: with the next capture far away, the step path shows
+        ZERO devicetime-originated syncs."""
+        engine = _engine(_tel_cfg(
+            tmp_path, devicetime=_fast_devicetime(every_steps=10_000,
+                                                  capture_steps=1)))
+        assert engine.devicetime is not None
+        batches = random_batches(np.random.default_rng(0), gas=1,
+                                 batch_size=16)
+        engine.train_batch(batches)
+        from deepspeed_tpu.utils import timer as timer_mod
+        calls = {"n": 0}
+        monkeypatch.setattr(timer_mod, "_device_synchronize",
+                            lambda: calls.__setitem__("n", calls["n"] + 1))
+        for _ in range(10):
+            engine.train_batch(batches)
+        assert calls["n"] == 0
+
+    def test_step_lowered_bit_identical(self, eight_devices, tmp_path):
+        """The observatory never touches the jitted step functions —
+        lowered step text identical with devicetime off vs on."""
+        batches_np = random_batches(np.random.default_rng(0), gas=1,
+                                    batch_size=16)
+        texts = []
+        for dt in (None, _fast_devicetime()):
+            engine = _engine(_tel_cfg(tmp_path / str(bool(dt)),
+                                      devicetime=dt))
+            placed = engine.put_batch(batches_np, leading_gas_dim=True)
+            lowered = engine._train_step.lower(engine.state, placed,
+                                               jnp.float32(1e-2))
+            texts.append(lowered.as_text())
+        assert texts[0] == texts[1]
+
+
+# ---------------------------------------------------------------------------
+# Config validation
+# ---------------------------------------------------------------------------
+class TestConfig:
+    def _cfg(self, devicetime, trace=None):
+        tel = {"enabled": True, "dir": "/tmp/x", "devicetime": devicetime}
+        if trace:
+            tel["trace"] = trace
+        return DeepSpeedTPUConfig({"train_micro_batch_size_per_gpu": 1,
+                                   "telemetry": tel})
+
+    def test_defaults_off(self):
+        cfg = DeepSpeedTPUConfig({"train_micro_batch_size_per_gpu": 1})
+        assert not cfg.telemetry.devicetime.enabled
+
+    def test_every_steps_must_exceed_capture(self):
+        with pytest.raises(ConfigError, match="every_steps"):
+            self._cfg({"enabled": True, "every_steps": 3,
+                       "capture_steps": 3})
+
+    def test_divergence_warn_range(self):
+        with pytest.raises(ConfigError, match="divergence_warn"):
+            self._cfg({"enabled": True, "divergence_warn": 0.0})
+
+    def test_keep_last_positive(self):
+        with pytest.raises(ConfigError, match="keep_last"):
+            self._cfg({"enabled": True, "keep_last": 0})
+
+    def test_passthrough_conflict_rejected(self):
+        with pytest.raises(ConfigError, match="jax_profiler_dir"):
+            self._cfg({"enabled": True},
+                      trace={"jax_profiler_dir": "/tmp/p"})
+
+
+# ---------------------------------------------------------------------------
+# CI/tooling: report + gate selftests run in tier-1 (satellite)
+# ---------------------------------------------------------------------------
+class TestToolSelftests:
+    def test_devicetime_report_selftest(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "devicetime_report.py"),
+             "--selftest"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "selftest ok" in out.stdout
+
+    def test_bench_gate_selftest(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+             "--selftest"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "selftest ok" in out.stdout
+
+    def test_bench_gate_detects_injected_regression(self, tmp_path):
+        """Acceptance: the gate passes a clean candidate (rc 0) and
+        catches an injected regression with a NONZERO rc (2)."""
+        gate = _load_tool("bench_gate")
+        baseline = {"sections": {"gpt2": {"tokens_per_sec": 100_000.0,
+                                          "mfu": 0.60}}}
+        basep = tmp_path / "BENCH_baseline.json"
+        basep.write_text(json.dumps(baseline))
+        clean = tmp_path / "clean.json"
+        clean.write_text(json.dumps(
+            {"sections": {"gpt2": {"tokens_per_sec": 98_000.0,
+                                   "mfu": 0.61}}}))
+        assert gate.main([str(clean), "--baseline", str(basep)]) == 0
+        regressed = tmp_path / "regressed.json"
+        regressed.write_text(json.dumps(
+            {"sections": {"gpt2": {"tokens_per_sec": 60_000.0,
+                                   "mfu": 0.60}}}))
+        rc = gate.main([str(regressed), "--baseline", str(basep)])
+        assert rc == 2, rc
+
+    def test_committed_baseline_parses_and_gates_its_source(self):
+        """BENCH_baseline.json (seeded from the last green TPU round) is
+        valid gate input, and its source bench JSON passes against it."""
+        gate = _load_tool("bench_gate")
+        rc = gate.main([os.path.join(REPO, "BENCH_r4_local.json")])
+        assert rc == 0
+
+    def test_bench_sections_schema_matches_gate(self):
+        """bench.py's _section_rows emits the schema sections_of consumes
+        (satellite: bench rows ride the gate's contract)."""
+        gate = _load_tool("bench_gate")
+        import bench
+        result = {}
+        bench._section_rows(result, "gpt2", tokens_per_sec=1000.0,
+                            mfu=0.5, skipped=None)
+        secs = gate.sections_of(result)
+        assert secs == {"gpt2": {"tokens_per_sec": 1000.0, "mfu": 0.5}}

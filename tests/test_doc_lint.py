@@ -17,6 +17,7 @@ import os
 import re
 
 from deepspeed_tpu.serving.engine import SERVING_METRIC_TAGS
+from deepspeed_tpu.telemetry.devicetime import DEVICETIME_METRIC_TAGS
 from deepspeed_tpu.telemetry.fleet import FLEET_METRIC_TAGS
 from deepspeed_tpu.telemetry.goodput import GOODPUT_METRIC_TAGS
 from deepspeed_tpu.telemetry.memory import MEMORY_METRIC_TAGS
@@ -32,6 +33,7 @@ _GOODPUT_TOKEN_RE = re.compile(r"goodput/[A-Za-z_]+")
 _FLEET_TOKEN_RE = re.compile(r"fleet/[A-Za-z_]+")
 _MEMORY_TOKEN_RE = re.compile(r"memory/[A-Za-z_]+")
 _SERVING_TOKEN_RE = re.compile(r"serving/[A-Za-z_]+")
+_DEVICETIME_TOKEN_RE = re.compile(r"devicetime/[A-Za-z_]+")
 
 
 def _iter_py_files():
@@ -137,6 +139,43 @@ class TestDocDrift:
         assert not phantom, (
             f"docs/OBSERVABILITY.md names memory tags the code never "
             f"emits: {phantom}")
+
+    def test_devicetime_tags_documented_and_vice_versa(self):
+        """The device-time surface (telemetry/devicetime.py) is pinned in
+        BOTH directions like goodput/fleet/memory: every tag the
+        observatory can emit — the per-category gauges, the capture
+        counter, the divergence instant and the measured exposed-comm
+        gauge — must be in the doc, and every devicetime/* token the doc
+        names must be one the code emits."""
+        doc = _doc_text()
+        undocumented = sorted(t for t in DEVICETIME_METRIC_TAGS
+                              if t not in doc)
+        assert not undocumented, undocumented
+        doc_tokens = set(_DEVICETIME_TOKEN_RE.findall(doc))
+        phantom = sorted(t for t in doc_tokens
+                         if t not in DEVICETIME_METRIC_TAGS)
+        assert not phantom, (
+            f"docs/OBSERVABILITY.md names devicetime tags the code never "
+            f"emits: {phantom}")
+        # the measured companion of comm/exposed_frac rides the same
+        # enforcement (it is a DEVICETIME_METRIC_TAGS member)
+        assert "comm/measured_exposed_frac" in DEVICETIME_METRIC_TAGS
+        assert "comm/measured_exposed_frac" in doc
+
+    def test_devicetime_report_tags_in_sync(self):
+        """tools/devicetime_report.py is stdlib-only by design (it loads
+        traceparse by file path, no package import), so its tag/key
+        strings are pinned here instead — every devicetime/* literal the
+        report names must be one the observatory emits."""
+        with open(os.path.join(REPO, "tools",
+                               "devicetime_report.py")) as f:
+            src = f.read()
+        report_tags = set(re.findall(r'"(devicetime/[A-Za-z_]+)"', src))
+        phantom = sorted(t for t in report_tags
+                         if t not in DEVICETIME_METRIC_TAGS)
+        assert not phantom, (
+            f"tools/devicetime_report.py reads tags the code never emits: "
+            f"{phantom} — keep it in sync with telemetry/devicetime.py")
 
     def test_serving_tags_documented_and_vice_versa(self):
         """The serving SLO surface (serving/engine.py) is pinned in BOTH

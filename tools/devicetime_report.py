@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Render the device-time observatory's measured attribution as a report.
+
+The measured companion of ``goodput_report``/``fleet_report``
+(docs/OBSERVABILITY.md "Device-time observatory"): feed it the job's
+``telemetry.dir`` — where each host's ``devicetime_breakdown.<host>.json``
+lands (bare name on single-host runs) — and get, per host, the HLO
+category table with roofline verdicts, host-dispatch gap, measured-vs-
+modeled MFU and exposed-comm, and the top-K hottest-op table (the
+Pallas-tier candidate list). ``--profile-dir`` instead parses raw
+``jax.profiler`` captures (``**/*.trace.json.gz``) directly — the
+hand-run-probe workflow, now one flag.
+
+Parsing lives in the shared ``telemetry/traceparse.py`` (stdlib only,
+loaded by file path) so this tool runs on hosts without jax, like the
+other report tools.
+
+Usage:
+    python tools/devicetime_report.py RUN_DIR [--json]
+    python tools/devicetime_report.py --profile-dir DIR [--top 10]
+    python tools/devicetime_report.py --selftest
+"""
+
+import argparse
+import glob
+import gzip
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+BREAKDOWN_GLOB = "devicetime_breakdown*.json"
+
+
+def _load_traceparse():
+    cached = sys.modules.get("dstpu_traceparse")
+    if cached is not None:
+        return cached
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "deepspeed_tpu", "telemetry", "traceparse.py")
+    spec = importlib.util.spec_from_file_location("dstpu_traceparse", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # One instance per process: a tool importing another tool (or tests
+    # loading several) must see the same COLLECTIVE_RE/CATEGORIES objects.
+    sys.modules["dstpu_traceparse"] = mod
+    return mod
+
+
+_tp = _load_traceparse()
+
+
+def load_breakdowns(run_dir: str) -> List[Dict[str, Any]]:
+    """Every host's devicetime breakdown under the run dir (unreadable
+    files skipped — a torn atomic rewrite must not kill the report)."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(run_dir, BREAKDOWN_GLOB))):
+        try:
+            with open(path) as f:
+                out.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def _fmt_pct(v: Optional[float]) -> str:
+    return f"{v:.1%}" if v is not None else "n/a"
+
+
+def render_breakdown(bd: Dict[str, Any]) -> str:
+    cats = bd.get("categories_sec", {})
+    busy = bd.get("busy_sec") or 0.0
+    verdicts = (bd.get("roofline") or {}).get("verdicts", {})
+    out = [f"host {bd.get('host', '?')} — capture @ step {bd.get('step')} "
+           f"({bd.get('steps_captured')} step(s), "
+           f"{bd.get('n_devices')} device row(s))"]
+    hdr = f"  {'category':<14} {'ms':>10} {'of busy':>8}  verdict"
+    out.append(hdr)
+    out.append("  " + "-" * (len(hdr) - 2))
+    for cat in list(_tp.CATEGORIES) + ["gap"]:
+        sec = bd.get("gap_sec", 0.0) if cat == "gap" else cats.get(cat, 0.0)
+        share = (sec / busy) if busy > 0 else 0.0
+        verdict = "host-dispatch" if cat == "gap" \
+            else verdicts.get(cat, "?")
+        out.append(f"  {cat:<14} {sec * 1e3:>10.2f} {share:>8.1%}  "
+                   f"{verdict}")
+    mfu_m, mfu_mod = bd.get("mfu_measured"), bd.get("mfu_modeled")
+    out.append(f"  mfu: measured {_fmt_pct(mfu_m)} vs modeled "
+               f"{_fmt_pct(mfu_mod)}")
+    exp = bd.get("exposed_comm") or {}
+    out.append(f"  exposed comm: measured {_fmt_pct(exp.get('measured_frac'))}"
+               f" vs modeled {_fmt_pct(exp.get('modeled_frac'))} "
+               f"({(exp.get('exposed_sec') or 0.0) * 1e3:.2f} ms exposed of "
+               f"{(exp.get('collective_sec') or 0.0) * 1e3:.2f} ms "
+               f"collective)")
+    hot = bd.get("top_ops") or []
+    if hot:
+        out.append("  hottest ops (Pallas-tier candidates):")
+        for r in hot:
+            out.append(f"    {r['name']:<32} {r['sec'] * 1e3:>9.2f} ms "
+                       f"x{r['count']:<5} {r['category']}")
+    return "\n".join(out)
+
+
+def render_analysis(analysis: Dict[str, Any], top: int = 10) -> str:
+    """Raw --profile-dir rendering (no engine join: categories, overlap,
+    hottest ops — the measured half only)."""
+    out = [f"measured device time — {len(analysis['captures'])} capture(s), "
+           f"{analysis['n_devices']} device row(s)"]
+    busy = analysis["busy_sec"] or 0.0
+    for cat in _tp.CATEGORIES:
+        sec = analysis["categories"][cat]
+        share = (sec / busy) if busy > 0 else 0.0
+        out.append(f"  {cat:<14} {sec * 1e3:>10.2f} ms {share:>8.1%}")
+    out.append(f"  {'gap':<14} {analysis['gap_sec'] * 1e3:>10.2f} ms "
+               f"(host-dispatch)")
+    window = analysis["window_sec"]
+    frac = (analysis["exposed_collective_sec"] / window) if window > 0 \
+        else 0.0
+    exposed_ms = analysis["exposed_collective_sec"] * 1e3
+    coll_ms = analysis["collective_sec"] * 1e3
+    out.append(f"  exposed comm: {exposed_ms:.2f} ms of {coll_ms:.2f} ms "
+               f"collective ({frac:.1%} of the device window)")
+    for r in _tp.top_ops(analysis, top):
+        out.append(f"  hot: {r['name']:<32} {r['sec'] * 1e3:>9.2f} ms "
+                   f"x{r['count']} ({r['category']})")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Selftest
+# ---------------------------------------------------------------------------
+
+def _selftest() -> int:
+    """Synthesize a gzip perfetto capture with known overlap, run the full
+    parse→render path, and verify the exposed-comm math and category
+    mapping — exercised from the test suite and CI."""
+    # Device 0: compute (dot) on stream 1 covers [0, 10ms]; a collective
+    # on stream 2 spans [5ms, 15ms] -> 5ms exposed of 10ms collective.
+    # Device 1: one fusion [0, 4ms]; runtime noise must be ignored.
+    events = [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": "/device:TPU:0"}},
+        {"name": "process_name", "ph": "M", "pid": 2, "tid": 0,
+         "args": {"name": "/device:TPU:1"}},
+        {"name": "process_name", "ph": "M", "pid": 9, "tid": 0,
+         "args": {"name": "/host:CPU"}},
+        {"name": "dot.1", "ph": "X", "pid": 1, "tid": 1, "ts": 0.0,
+         "dur": 10_000.0},
+        {"name": "all-reduce.7", "ph": "X", "pid": 1, "tid": 2,
+         "ts": 5_000.0, "dur": 10_000.0},
+        {"name": "fusion.3", "ph": "X", "pid": 2, "tid": 1, "ts": 0.0,
+         "dur": 4_000.0},
+        {"name": "transpose.9", "ph": "X", "pid": 2, "tid": 1,
+         "ts": 4_000.0, "dur": 1_000.0},
+        # host-side runtime scaffolding: never attributed
+        {"name": "TfrtCpuExecutable::Execute", "ph": "X", "pid": 9,
+         "tid": 1, "ts": 0.0, "dur": 50_000.0},
+    ]
+    with tempfile.TemporaryDirectory() as td:
+        cap = os.path.join(td, "plugins", "profile", "2026_01_01")
+        os.makedirs(cap)
+        with gzip.open(os.path.join(cap, "host.trace.json.gz"), "wt") as f:
+            json.dump({"traceEvents": events}, f)
+        # a torn capture next to it must be tolerated
+        with open(os.path.join(cap, "torn.trace.json.gz"), "wb") as f:
+            f.write(b"\x1f\x8b\x08\x00garbage")
+        analysis = _tp.parse_capture_dir(td)
+        text = render_analysis(analysis)
+    assert analysis["n_devices"] == 2, analysis["n_devices"]
+    c = analysis["categories"]
+    assert abs(c["matmul"] - 0.010) < 1e-9, c
+    assert abs(c["collective"] - 0.010) < 1e-9, c
+    assert abs(c["elementwise"] - 0.004) < 1e-9, c
+    assert abs(c["copy"] - 0.001) < 1e-9, c
+    assert c["other"] == 0.0, c
+    assert abs(analysis["exposed_collective_sec"] - 0.005) < 1e-9, analysis
+    # busy: dev0 union [0,15] + dev1 [0,5]; windows 15 + 5; no gaps
+    assert abs(analysis["busy_sec"] - 0.020) < 1e-9
+    assert abs(analysis["window_sec"] - 0.020) < 1e-9
+    assert analysis["gap_sec"] < 1e-12
+    assert len(analysis["captures"]) == 1        # torn file skipped
+    assert "dot.1" in text and "exposed comm" in text
+    # breakdown rendering (the engine-written artifact)
+    bd = {"format": 1, "step": 40, "host": "hostA", "steps_captured": 2,
+          "n_devices": 2,
+          "categories_sec": dict(analysis["categories"]),
+          "gap_sec": analysis["gap_sec"], "busy_sec": analysis["busy_sec"],
+          "window_sec": analysis["window_sec"], "step_time_sec": 0.01,
+          "top_ops": _tp.top_ops(analysis, 3),
+          "roofline": {"intensity_flops_per_byte": 120.0,
+                       "ridge_flops_per_byte": 240.0,
+                       "verdicts": {"matmul": "hbm-bound",
+                                    "elementwise": "hbm-bound",
+                                    "copy": "hbm-bound",
+                                    "collective": "network-bound",
+                                    "other": "mixed"}},
+          "mfu_measured": 0.41, "mfu_modeled": 0.44,
+          "exposed_comm": {"collective_sec": 0.010, "exposed_sec": 0.005,
+                           "measured_frac": 0.25, "modeled_frac": 0.02},
+          "captures": ["x.trace.json.gz"]}
+    with tempfile.TemporaryDirectory() as td:
+        with open(os.path.join(td, "devicetime_breakdown.hostA.json"),
+                  "w") as f:
+            json.dump(bd, f)
+        loaded = load_breakdowns(td)
+    assert len(loaded) == 1
+    btext = render_breakdown(loaded[0])
+    assert "hbm-bound" in btext and "network-bound" in btext
+    assert "41.0%" in btext and "25.0%" in btext
+    print(text)
+    print()
+    print(btext)
+    print("\nselftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", nargs="?",
+                    help="the job's telemetry.dir (devicetime breakdown "
+                         "files)")
+    ap.add_argument("--profile-dir",
+                    help="parse raw jax.profiler captures "
+                         "(*.trace.json.gz) directly instead")
+    ap.add_argument("--top", type=int, default=10,
+                    help="hottest-op rows for --profile-dir mode")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged report as JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in round-trip check and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if args.profile_dir:
+        analysis = _tp.parse_capture_dir(args.profile_dir)
+        if args.json:
+            print(json.dumps(analysis, indent=1))
+        else:
+            print(render_analysis(analysis, top=args.top))
+        return 0
+    if not args.run_dir:
+        ap.error("run dir required (or --profile-dir / --selftest)")
+    breakdowns = load_breakdowns(args.run_dir)
+    if args.json:
+        print(json.dumps(breakdowns, indent=1))
+        return 0
+    if not breakdowns:
+        print(f"no {BREAKDOWN_GLOB} under {args.run_dir} — is "
+              f"telemetry.devicetime enabled and has a capture closed?")
+        return 1
+    print("\n\n".join(render_breakdown(bd) for bd in breakdowns))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,20 +29,40 @@ Usage:
 
 import argparse
 import glob
-import gzip
+import importlib.util
 import json
 import os
-import re
 import sys
 import tempfile
 from typing import Any, Dict, List, Optional
 
+
+def _load_traceparse():
+    """Load telemetry/traceparse.py by path (stdlib-only module): ONE
+    capture parser in the tree, and this tool stays runnable on hosts
+    where the package (and jax) cannot import."""
+    cached = sys.modules.get("dstpu_traceparse")
+    if cached is not None:
+        return cached
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "deepspeed_tpu", "telemetry", "traceparse.py")
+    spec = importlib.util.spec_from_file_location("dstpu_traceparse", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # One instance per process: a tool importing another tool (or tests
+    # loading several) must see the same COLLECTIVE_RE/CATEGORIES objects.
+    sys.modules["dstpu_traceparse"] = mod
+    return mod
+
+
+_tp = _load_traceparse()
+
 MANIFEST_PREFIX = "run_manifest."
 BREAKDOWN_GLOB = "fleet_breakdown*.json"
-# XLA collective op names inside a jax.profiler capture.
-COLLECTIVE_RE = re.compile(
-    r"all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute",
-    re.IGNORECASE)
+# THE collective-op-name list + the capture scan both live in traceparse
+# now; re-bound here so the historical names keep working.
+COLLECTIVE_RE = _tp.COLLECTIVE_RE
+scan_profile_dir = _tp.scan_profile_dir
 
 # Metric tags the merge consumes (last value per (host, tag) wins — the
 # gauges are cumulative).
@@ -266,34 +286,6 @@ def merge_timeline(trace_paths: Dict[Optional[str], str]) -> Dict[str, Any]:
     return {"traceEvents": merged, "displayTimeUnit": "ms",
             "metadata": {"aligned_to_wall_epoch": base if anchors else None,
                          "hosts": [l for l, _, _ in docs]}}
-
-
-def scan_profile_dir(profile_dir: str) -> Dict[str, Dict[str, float]]:
-    """Measured collective vs total device time per ``jax.profiler``
-    perfetto capture (``**/*.trace.json.gz``) — the ground truth the
-    modeled ``comm/exposed_frac`` is checked against."""
-    out: Dict[str, Dict[str, float]] = {}
-    pattern = os.path.join(profile_dir, "**", "*.trace.json.gz")
-    for path in sorted(glob.glob(pattern, recursive=True)):
-        try:
-            with gzip.open(path, "rt") as f:
-                doc = json.load(f)
-        except (OSError, ValueError):
-            continue
-        events = (doc.get("traceEvents", [])
-                  if isinstance(doc, dict) else doc)
-        total = coll = 0.0
-        for ev in events:
-            if ev.get("ph") != "X":
-                continue
-            dur = float(ev.get("dur", 0.0))
-            total += dur
-            if COLLECTIVE_RE.search(ev.get("name", "")):
-                coll += dur
-        rel = os.path.relpath(path, profile_dir)
-        out[rel] = {"collective_ms": coll / 1e3, "total_ms": total / 1e3,
-                    "collective_frac": (coll / total) if total > 0 else 0.0}
-    return out
 
 
 # ---------------------------------------------------------------------------

@@ -208,16 +208,34 @@ def main():
         f"{full_step*1e3:.1f} ms/step -> "
         f"{GAS*step_flops/full_step/1e12:.1f} TFLOP/s overall")
 
-    # --- 9. try a real trace --------------------------------------------
-    if on_tpu:
-        try:
-            with jax.profiler.trace("/root/repo/profiles/gpt2"):
-                for _ in range(3):
-                    out = grad_fn(params, batch)
-                jax.block_until_ready(out)
-            log("[trace] written to /root/repo/profiles/gpt2")
-        except Exception as e:  # noqa: BLE001
-            log(f"[trace] jax.profiler failed (axon tunnel): {e}")
+    # --- 9. real capture -> measured attribution ------------------------
+    # One parser in the tree: the capture round-trips through
+    # telemetry/traceparse.py (the same module the devicetime observatory
+    # and the report tools use) instead of a hand-rolled scan.
+    try:
+        from deepspeed_tpu.telemetry import traceparse
+        trace_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "profiles", "gpt2")
+        with jax.profiler.trace(trace_dir):
+            for _ in range(3):
+                out = grad_fn(params, batch)
+            jax.block_until_ready(out)
+        log(f"[trace] written to {trace_dir}")
+        analysis = traceparse.parse_capture_dir(trace_dir)
+        log(f"[trace] measured attribution over "
+            f"{len(analysis['captures'])} capture(s), "
+            f"{analysis['n_devices']} device row(s): busy "
+            f"{analysis['busy_sec'] * 1e3:.1f} ms, gap "
+            f"{analysis['gap_sec'] * 1e3:.1f} ms")
+        for cat in traceparse.CATEGORIES:
+            sec = analysis["categories"][cat]
+            if sec > 0:
+                log(f"[trace]   {cat:<12} {sec * 1e3:>10.2f} ms")
+        for r in traceparse.top_ops(analysis, 10):
+            log(f"[trace]   hot: {r['name']:<32} {r['sec'] * 1e3:>9.2f} ms "
+                f"x{r['count']} ({r['category']})")
+    except Exception as e:  # noqa: BLE001
+        log(f"[trace] jax.profiler failed (axon tunnel): {e}")
 
 
 if __name__ == "__main__":

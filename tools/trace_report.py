@@ -7,8 +7,10 @@ where step time goes — total / count / mean / p50 / p99 / share per span
 name — plus counter summaries (e.g. ``telemetry/recompiles``) and instant
 events (retrace markers).
 
-Standalone on purpose: imports nothing beyond the stdlib, so it runs
-anywhere a trace file lands (including hosts without jax installed).
+Parsing lives in the shared ``telemetry/traceparse.py`` (itself stdlib
+only); this tool loads it by file path — no package import, no jax — so
+it still runs anywhere a trace file lands. Rendering and the CLI stay
+here.
 
 Multiple traces (or a glob): every span row is prefixed with its source
 host (``hostA:train_step``) — from each file's ``metadata.host``, or the
@@ -22,112 +24,43 @@ Usage:
 """
 
 import argparse
-import glob as _glob
+import importlib.util
 import json
 import os
 import sys
 import tempfile
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict
 
 
-def load_doc(path: str) -> Dict[str, Any]:
-    with open(path) as f:
-        doc = json.load(f)
-    if isinstance(doc, list):  # bare-array Chrome trace variant
-        doc = {"traceEvents": doc}
-    if not isinstance(doc, dict):
-        raise ValueError(f"{path}: not a Chrome trace (dict or list)")
-    events = doc.get("traceEvents", [])
-    if not isinstance(events, list):
-        raise ValueError(f"{path}: traceEvents is not a list")
-    return doc
+def _load_traceparse():
+    """Load telemetry/traceparse.py by path: the module is stdlib-only,
+    and a spec-load keeps this tool runnable on hosts where the package
+    (and jax) cannot import."""
+    cached = sys.modules.get("dstpu_traceparse")
+    if cached is not None:
+        return cached
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "deepspeed_tpu", "telemetry", "traceparse.py")
+    spec = importlib.util.spec_from_file_location("dstpu_traceparse", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # One instance per process: a tool importing another tool (or tests
+    # loading several) must see the same COLLECTIVE_RE/CATEGORIES objects.
+    sys.modules["dstpu_traceparse"] = mod
+    return mod
 
 
-def load_events(path: str) -> List[Dict[str, Any]]:
-    return load_doc(path)["traceEvents"]
+_tp = _load_traceparse()
 
-
-def host_label(path: str, doc: Dict[str, Any]) -> str:
-    """Source-host label: trace metadata first, then the
-    ``<stem>.<host>.json`` filename component, then the file stem."""
-    host = (doc.get("metadata") or {}).get("host")
-    if host:
-        return str(host)
-    stem = os.path.basename(path)
-    if stem.endswith(".json"):
-        stem = stem[:-len(".json")]
-    parts = stem.split(".")
-    return parts[-1] if len(parts) > 1 else stem
-
-
-def load_many(paths: List[str]) -> List[Dict[str, Any]]:
-    """Load several trace files into one event list, each event's name
-    prefixed with its source host."""
-    events: List[Dict[str, Any]] = []
-    for path in paths:
-        doc = load_doc(path)
-        label = host_label(path, doc)
-        for ev in doc["traceEvents"]:
-            if "name" in ev and ev.get("ph") != "M":
-                ev = dict(ev)
-                ev["name"] = f"{label}:{ev['name']}"
-            events.append(ev)
-    return events
-
-
-def expand_paths(args_traces: List[str]) -> List[str]:
-    """Expand glob patterns (quoted globs reach us unexpanded) and keep
-    explicit paths as-is."""
-    out: List[str] = []
-    for t in args_traces:
-        matches = sorted(_glob.glob(t))
-        out.extend(matches if matches else [t])
-    return out
-
-
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    if len(sorted_vals) == 1:
-        return sorted_vals[0]
-    pos = (q / 100.0) * (len(sorted_vals) - 1)
-    lo = int(pos)
-    hi = min(lo + 1, len(sorted_vals) - 1)
-    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo)
-
-
-def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
-    spans: Dict[str, List[float]] = {}
-    counters: Dict[str, float] = {}
-    instants: Dict[str, int] = {}
-    for ev in events:
-        ph = ev.get("ph")
-        name = ev.get("name", "<unnamed>")
-        if ph == "X":
-            spans.setdefault(name, []).append(float(ev.get("dur", 0.0)))
-        elif ph == "C":
-            args = ev.get("args") or {}
-            # last write wins: counters carry running totals
-            for k, v in args.items():
-                counters[name if k == "value" else f"{name}.{k}"] = float(v)
-        elif ph == "i" or ph == "I":
-            instants[name] = instants.get(name, 0) + 1
-    rows = []
-    for name, durs in spans.items():
-        durs.sort()
-        total = sum(durs)
-        rows.append({
-            "name": name,
-            "count": len(durs),
-            "total_ms": total / 1e3,
-            "mean_ms": total / len(durs) / 1e3,
-            "p50_ms": _percentile(durs, 50) / 1e3,
-            "p99_ms": _percentile(durs, 99) / 1e3,
-        })
-    grand = sum(r["total_ms"] for r in rows) or 1.0
-    for r in rows:
-        r["share"] = r["total_ms"] / grand
-    return {"spans": rows, "counters": counters, "instants": instants}
+# Historical module-level API (tests and other tools import these from
+# here) — one implementation, in traceparse.
+load_doc = _tp.load_doc
+load_events = _tp.load_events
+host_label = _tp.host_label
+load_many = _tp.load_many
+expand_paths = _tp.expand_paths
+summarize = _tp.summarize
+_percentile = _tp.percentile
 
 
 def render(summary: Dict[str, Any], sort: str = "total") -> str:
