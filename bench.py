@@ -451,6 +451,11 @@ def main():
         # "peak_headroom_bytes" (a free post-section memory_stats read)
         # so capacity regressions show up next to the throughput rows.
         "memory": "off",
+        # Numerics observatory (telemetry/numerics.py) off: the in-
+        # program per-group stat reductions would ride inside the timed
+        # step programs; a future BENCH round measuring with numerics on
+        # must record its block here so rows stay attributable.
+        "numerics": "off",
         "peak_tflops_per_chip": peak,
         # Gradient-sync strategy the rows were measured under
         # (comm/grad_sync.py): none of the bench configs set a comm

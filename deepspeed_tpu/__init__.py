@@ -267,9 +267,14 @@ def init_serving(model=None, config=None, **kwargs):
             config = _json.load(f)
     config = dict(config or {})
     scfg = ServingConfig.from_dict(config.get("serving"))
-    tel = build_telemetry(TelemetryConfig.from_dict(config.get("telemetry")))
+    tcfg = TelemetryConfig.from_dict(config.get("telemetry"))
+    tel = build_telemetry(tcfg)
     engine = init_inference(model, tracer=tel.tracer, **kwargs)
-    return ServeEngine(engine, config=scfg, telemetry=tel)
+    # telemetry.numerics opt-in gates the per-prefill int8 KV-cache
+    # round-trip-error gauge (docs/OBSERVABILITY.md "Numerics
+    # observatory") — telemetry-only deployments pay nothing extra.
+    return ServeEngine(engine, config=scfg, telemetry=tel,
+                       measure_kv_quant_error=tcfg.numerics.enabled)
 
 
 __all__ = [

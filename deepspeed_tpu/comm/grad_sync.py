@@ -58,7 +58,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.comm.quantize import (dequantize_blockwise,
                                          modeled_wire_bytes,
-                                         quantize_blockwise)
+                                         quantize_blockwise, rel_from_parts,
+                                         roundtrip_error_parts)
 from deepspeed_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
 from deepspeed_tpu.utils.jax_compat import shard_map
 from deepspeed_tpu.utils.logging import log_dist
@@ -153,7 +154,8 @@ class GradSyncPlan:
     """
 
     def __init__(self, comm_cfg, mesh: Mesh, grad_template: Any,
-                 grad_specs: Any, acc_dtype, ici_dtype=None, gas: int = 1):
+                 grad_specs: Any, acc_dtype, ici_dtype=None, gas: int = 1,
+                 measure_quant_error: bool = False):
         self.mesh = mesh
         self.dcn_size = int(mesh.shape.get(DCN_AXIS, 1))
         self.data_size = int(mesh.shape.get(DATA_AXIS, 1))
@@ -170,6 +172,13 @@ class GradSyncPlan:
                                       _C.COMM_DCN_GBPS_DEFAULT))
         self.acc_dtype = acc_dtype
         self.ici_dtype = ici_dtype if ici_dtype is not None else acc_dtype
+        # Numerics observatory (telemetry/numerics.py): when on, the DCN
+        # stage also returns per-bucket RTNE round-trip error of the wire
+        # payload vs the fp32 shard. Only the lossy tiers measure — the
+        # fp32 passthrough has nothing to attribute. Off (the default)
+        # the shard_map body is byte-for-byte the pre-numerics one.
+        self.measure_quant = (bool(measure_quant_error)
+                              and int(comm_cfg.dcn_quant_bits) in (8, 16))
         # Micro-steps per optimizer step THIS plan's region runs: each one
         # reduce-scatters every bucket over ICI, so the modeled ICI bytes
         # scale with it (the pipe engine's single pipelined fwd/bwd is 1).
@@ -296,15 +305,26 @@ class GradSyncPlan:
     # ------------------------------------------------------------------
     # stage 2 (jit level, manual={dcn, data})
     # ------------------------------------------------------------------
-    def _dcn_allreduce_local(self, chunk: jax.Array) -> jax.Array:
+    def _dcn_allreduce_local(self, chunk: jax.Array):
         """Body of the DCN stage for ONE bucket's local scattered shard
         ``chunk`` [bucket_elems / data_size]: all-reduce it across slices
-        with the configured wire dtype, return the fully-gathered bucket
-        [bucket_elems]. Runs inside the manual={dcn, data} region."""
+        with the configured wire dtype, return ``(gathered_bucket
+        [bucket_elems], err)`` where ``err`` — when
+        ``measure_quant_error`` is on (None otherwise: the lowering is
+        then unchanged) — is this shard's local round-trip-error
+        accumulables for BOTH lossy hops the wire takes,
+        ``(err_sq, ref_sq, max_abs)`` of the outbound payload followed
+        by the same triple for the reduced bucket's re-quantization
+        before the return all-gather. Measuring only the first hop
+        would systematically underreport the end-to-end error (~sqrt(2)x
+        for similar hops). Runs inside the manual={dcn, data} region."""
         n = self.dcn_size
         sub = chunk.shape[0] // n
         parts = chunk.reshape(n, sub)
         inv = 1.0 / n
+        err1 = (roundtrip_error_parts(parts, self.bits, self.block)
+                if self.measure_quant else None)
+        err2 = None
         if self.bits == 8:
             q, s = quantize_blockwise(parts, self.block)
             rq = jax.lax.all_to_all(q, DCN_AXIS, split_axis=0,
@@ -313,6 +333,10 @@ class GradSyncPlan:
                                     concat_axis=0, tiled=False)
             red = jnp.sum(dequantize_blockwise(rq, rs, self.block),
                           axis=0) * inv
+            if self.measure_quant:
+                # Second hop: the reduced bucket is re-quantized for the
+                # return all-gather — an independent RTNE stage.
+                err2 = roundtrip_error_parts(red, self.bits, self.block)
             q2, s2 = quantize_blockwise(red, self.block)
             aq = jax.lax.all_gather(q2, DCN_AXIS, axis=0, tiled=False)
             a_s = jax.lax.all_gather(s2, DCN_AXIS, axis=0, tiled=False)
@@ -330,33 +354,68 @@ class GradSyncPlan:
                                     split_axis=0, concat_axis=0,
                                     tiled=False)
             red = (jnp.sum(rp.astype(jnp.float32), axis=0) * inv)
+            if self.measure_quant:
+                # Second hop (bits=16 only measures): the reduced bucket
+                # returns over DCN as bf16 — the same cast loss again.
+                err2 = roundtrip_error_parts(red, self.bits, self.block)
             ag = jax.lax.all_gather(red.astype(wire), DCN_AXIS, axis=0,
                                     tiled=False)
             mine = ag.astype(jnp.float32).reshape(-1)
+        err = (err1 + err2) if self.measure_quant else None
         # All-gather the reduced chunk back over ICI: the bucket leaves
         # this region replicated and the engine's grad-spec constraint
         # re-shards it locally (no further traffic).
-        return jax.lax.all_gather(mine, DATA_AXIS, axis=0, tiled=True)
+        return jax.lax.all_gather(mine, DATA_AXIS, axis=0, tiled=True), err
 
-    def dcn_sync(self, stacked: Tuple[jax.Array, ...]
-                 ) -> Tuple[jax.Array, ...]:
+    def dcn_sync(self, stacked: Tuple[jax.Array, ...]):
         """DCN stage entry: ``stacked`` buckets are [dcn, bucket_elems]
         (stage 1 stacks each slice's partial on a leading dcn dim).
-        Returns fully-reduced fp32 buckets, one HLO collective chain per
-        bucket so the scheduler can overlap them."""
+        Returns ``(buckets, qerr)``: fully-reduced fp32 buckets, one HLO
+        collective chain per bucket so the scheduler can overlap them,
+        plus — when ``measure_quant_error`` is on — a replicated
+        ``[num_buckets, 2]`` fp32 array of (rel-L2, max-abs) round-trip
+        error per bucket, psum'd/pmax'd over the whole manual region
+        (None otherwise). rel-L2 is the root-sum-square of the two RTNE
+        hops (outbound payload + reduced-bucket re-quantization) — the
+        error-propagation estimate of the END-TO-END error vs an fp32
+        all-reduce; max-abs is the two hops' worst-case sum, in
+        accumulator units — under fp16 that includes the loss scale."""
         if not stacked:
-            return ()
+            return (), None
         if self._dcn_sync_fn is None:
-            def body(*bs):
-                return tuple(self._dcn_allreduce_local(b[0]) for b in bs)
+            measure = self.measure_quant
 
+            def body(*bs):
+                res = [self._dcn_allreduce_local(b[0]) for b in bs]
+                bufs = tuple(r[0] for r in res)
+                if not measure:
+                    return bufs
+                rows = []
+                for _, (e1, r1, m1, e2, r2, m2) in res:
+                    axes = (DCN_AXIS, DATA_AXIS)
+                    rel1 = rel_from_parts(jax.lax.psum(e1, axes),
+                                          jax.lax.psum(r1, axes))
+                    rel2 = rel_from_parts(jax.lax.psum(e2, axes),
+                                          jax.lax.psum(r2, axes))
+                    mab = (jax.lax.pmax(m1, axes)
+                           + jax.lax.pmax(m2, axes))
+                    rows.append(jnp.stack(
+                        [jnp.sqrt(rel1 * rel1 + rel2 * rel2), mab]))
+                return bufs, jnp.stack(rows)
+
+            out_specs = tuple(P() for _ in stacked)
+            if measure:
+                out_specs = (out_specs, P())
             self._dcn_sync_fn = shard_map(
                 body, mesh=self.mesh,
                 in_specs=tuple(P(DCN_AXIS, DATA_AXIS) for _ in stacked),
-                out_specs=tuple(P() for _ in stacked),
+                out_specs=out_specs,
                 axis_names={DCN_AXIS, DATA_AXIS},
                 check_vma=False)
-        return self._dcn_sync_fn(*stacked)
+        out = self._dcn_sync_fn(*stacked)
+        if self.measure_quant:
+            return out[0], out[1]
+        return out, None
 
     # ------------------------------------------------------------------
     # jit level
@@ -452,11 +511,15 @@ class GradSyncPlan:
     # modeling / telemetry
     # ------------------------------------------------------------------
     def sync_grads(self, stacked: Tuple[jax.Array, ...],
-                   synced_fallback: Sequence[jax.Array]) -> Any:
+                   synced_fallback: Sequence[jax.Array]
+                   ) -> Tuple[Any, Optional[jax.Array]]:
         """DCN-sync the stage-1 buckets and slice them back into the grad
         tree — the one sequence every hierarchical step runs after
-        :meth:`run_manual_gas`."""
-        return self.unbucket(self.dcn_sync(stacked), synced_fallback)
+        :meth:`run_manual_gas`. Returns ``(grads_tree, qerr)``; ``qerr``
+        is :meth:`dcn_sync`'s per-bucket error array (None unless
+        ``measure_quant_error``)."""
+        buckets, qerr = self.dcn_sync(stacked)
+        return self.unbucket(buckets, synced_fallback), qerr
 
     def _per_bucket_dcn_bytes(self) -> int:
         """Modeled DCN wire bytes for one bucket (both directions) — the

@@ -80,6 +80,53 @@ def dequantize_blockwise(q: jax.Array, scales: jax.Array,
     return out.reshape(*lead, m)
 
 
+def roundtrip_error_parts(x: jax.Array, bits: int = 8,
+                          block_size: int = 256
+                          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Raw accumulables of the round-trip error — ``(err_sq, ref_sq,
+    max_abs)`` fp32 scalars — so callers inside manual collectives can
+    ``psum``/``pmax`` them across shards before forming the relative
+    error (the DCN grad-sync gauge does exactly that). ``bits``: 8 is
+    the blockwise int8 RTNE round trip, 16 the bf16 cast, >=32 exact
+    (zero error). NaN-transparent: a nonfinite input block poisons its
+    scale (see :func:`quantize_blockwise`), so err/max propagate NaN
+    instead of hiding the overflow."""
+    x32 = x.astype(jnp.float32)
+    ref_sq = jnp.sum(x32 * x32)
+    if bits >= 32:
+        zero = jnp.float32(0.0)
+        return zero, ref_sq, zero
+    if bits == 16:
+        dq = x32.astype(jnp.bfloat16).astype(jnp.float32)
+    else:
+        q, s = quantize_blockwise(x32, block_size, bits=bits)
+        dq = dequantize_blockwise(q, s, block_size)
+    diff = dq - x32
+    return jnp.sum(diff * diff), ref_sq, jnp.max(jnp.abs(diff))
+
+
+def rel_from_parts(err_sq: jax.Array, ref_sq: jax.Array) -> jax.Array:
+    """rel-L2 from (possibly psum'd) round-trip-error accumulables — the
+    ONE combine formula, shared by :func:`roundtrip_error` and the DCN
+    grad-sync gauge so the two error surfaces can never desynchronize
+    (zero reference -> 0, not inf; NaN propagates)."""
+    return jnp.sqrt(err_sq) / jnp.sqrt(jnp.maximum(ref_sq, 1e-30))
+
+
+def roundtrip_error(x: jax.Array, bits: int = 8,
+                    block_size: int = 256) -> Tuple[jax.Array, jax.Array]:
+    """Round-trip quantization error of ``x``'s last dim in blocks:
+    ``(rel_l2, max_abs)`` fp32 scalars, where ``rel_l2 = ||dq(q(x)) -
+    x||_2 / ||x||_2`` (0 for an all-zero input — zero blocks round-trip
+    exactly) and ``max_abs`` is the worst per-element error (bounded by
+    half the per-block scale for finite blocks — RTNE). The shared
+    measurement core of the DCN grad-sync and int8 KV-cache error
+    gauges (telemetry/numerics.py); NaN-transparent like the parts
+    helper."""
+    err_sq, ref_sq, max_abs = roundtrip_error_parts(x, bits, block_size)
+    return rel_from_parts(err_sq, ref_sq), max_abs
+
+
 def modeled_wire_bytes(num_elems: int, bits: int, block_size: int) -> int:
     """Bytes one direction of a quantized transfer of ``num_elems`` puts
     on the wire: payload codes + per-block fp32 scales. For the bf16/fp32

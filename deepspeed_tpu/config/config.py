@@ -722,6 +722,45 @@ class TelemetryDevicetimeConfig:
 
 
 @dataclass
+class TelemetryNumericsConfig:
+    """Numerics observatory knobs (telemetry/numerics.py): per-layer-group
+    gradient/weight/update statistics + bf16/fp16 saturation and
+    underflow-to-zero counters computed inside the jitted step as one
+    small stacked aux array (fetched in a single transfer at flush
+    boundaries), plus per-bucket DCN / KV-cache quantization-error
+    gauges. Default off — the lowered step is then bit-identical to a
+    numerics-less config; enabled, the stats ride the existing step
+    program and the step path performs zero extra host fetches."""
+
+    enabled: bool = C.TELEMETRY_NUMERICS_ENABLED_DEFAULT
+    max_groups: int = C.TELEMETRY_NUMERICS_MAX_GROUPS_DEFAULT
+    max_spike_dumps: int = C.TELEMETRY_NUMERICS_MAX_SPIKE_DUMPS_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> \
+            "TelemetryNumericsConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.TELEMETRY_NUMERICS_ENABLED,
+                              C.TELEMETRY_NUMERICS_ENABLED_DEFAULT)),
+            max_groups=int(_get(d, C.TELEMETRY_NUMERICS_MAX_GROUPS,
+                                C.TELEMETRY_NUMERICS_MAX_GROUPS_DEFAULT)),
+            max_spike_dumps=int(_get(
+                d, C.TELEMETRY_NUMERICS_MAX_SPIKE_DUMPS,
+                C.TELEMETRY_NUMERICS_MAX_SPIKE_DUMPS_DEFAULT)),
+        )
+        if cfg.max_groups < 1:
+            raise ConfigError(
+                f"telemetry.numerics.max_groups must be >= 1, got "
+                f"{cfg.max_groups}")
+        if cfg.max_spike_dumps < 0:
+            raise ConfigError(
+                f"telemetry.numerics.max_spike_dumps must be >= 0, got "
+                f"{cfg.max_spike_dumps}")
+        return cfg
+
+
+@dataclass
 class TelemetryConfig:
     """Unified observability (telemetry/; docs/OBSERVABILITY.md): metrics
     registry + Chrome-trace step tracer + recompilation detector. Disabled
@@ -750,6 +789,11 @@ class TelemetryConfig:
     # measured exposed-comm. Opt-in (profiler work at capture boundaries).
     devicetime: TelemetryDevicetimeConfig = field(
         default_factory=TelemetryDevicetimeConfig)
+    # Numerics observatory (telemetry/numerics.py): per-layer-group
+    # grad/update stats + saturation counters + quantization-error
+    # gauges. Opt-in (adds in-program stat reductions to the step).
+    numerics: TelemetryNumericsConfig = field(
+        default_factory=TelemetryNumericsConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
@@ -769,6 +813,8 @@ class TelemetryConfig:
                 d.get(C.TELEMETRY_MEMORY)),
             devicetime=TelemetryDevicetimeConfig.from_dict(
                 d.get(C.TELEMETRY_DEVICETIME)),
+            numerics=TelemetryNumericsConfig.from_dict(
+                d.get(C.TELEMETRY_NUMERICS)),
         )
         if cfg.enabled and not cfg.dir:
             raise ConfigError(

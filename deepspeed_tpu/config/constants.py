@@ -241,6 +241,20 @@ TELEMETRY_DEVICETIME_TOP_K_DEFAULT = 10           # hottest-op table rows
 TELEMETRY_DEVICETIME_DIVERGENCE_WARN = "divergence_warn"
 TELEMETRY_DEVICETIME_DIVERGENCE_WARN_DEFAULT = 0.25  # |measured-modeled|
 TELEMETRY_DEVICETIME_HBM_GBPS = "hbm_gbps"        # None -> per-kind table
+# Numerics observatory (telemetry/numerics.py): per-layer-group
+# gradient/update statistics + dtype-saturation counters computed INSIDE
+# the jitted step (one small stacked aux array, fetched once per flush),
+# and quantization-error attribution for the int8 wire paths. Default
+# OFF: enabled it adds the in-program stat reductions to the step
+# program (the lowered step changes — explicit opt-in, unlike the
+# jaxpr-neutral memory observatory) and one host transfer per flush.
+TELEMETRY_NUMERICS = "numerics"
+TELEMETRY_NUMERICS_ENABLED = "enabled"
+TELEMETRY_NUMERICS_ENABLED_DEFAULT = False
+TELEMETRY_NUMERICS_MAX_GROUPS = "max_groups"
+TELEMETRY_NUMERICS_MAX_GROUPS_DEFAULT = 16        # top-level key cap
+TELEMETRY_NUMERICS_MAX_SPIKE_DUMPS = "max_spike_dumps"
+TELEMETRY_NUMERICS_MAX_SPIKE_DUMPS_DEFAULT = 8    # per-run dump budget
 
 #############################################
 # Serving (TPU-native block, no reference analogue: continuous-batching

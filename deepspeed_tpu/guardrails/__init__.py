@@ -28,6 +28,8 @@ test does). Enabled, the per-step cost is two scalar host fetches plus an
 amortised ring snapshot every ``snapshot_interval`` steps.
 """
 
+import json
+import os
 from typing import Any, Callable, Optional
 
 from deepspeed_tpu.guardrails.detector import (OK, SKIP, SPIKE,
@@ -101,6 +103,11 @@ class Guardrails:
                 metrics_tail_of=metrics_path).start()
         self._data_skip_fn: Optional[Callable[[int], None]] = None
         self.last_verdict: Optional[Verdict] = None
+        # Numerics integration (telemetry/numerics.py): spike verdicts
+        # name the worst-offending layer group and leave a bounded number
+        # of spike crashdumps naming it (budget from the numerics block).
+        self.metrics_path = metrics_path
+        self._spike_dumps = 0
 
     # ------------------------------------------------------------------
     @property
@@ -135,14 +142,30 @@ class Guardrails:
         verdict = self.detector.observe(step, lossf, grad_norm=normf,
                                         overflow=of)
         self.last_verdict = verdict
-        self._emit(step, verdict)
+        # Numerics observatory (telemetry/numerics.py): a spike names
+        # the worst-offending layer group — the first nonfinite grad
+        # group, else the largest grad-to-weight ratio. One extra
+        # transfer, on (rare) spike verdicts only.
+        worst = None
+        numerics = getattr(engine, "numerics", None)
+        if verdict.kind == SPIKE and numerics is not None:
+            try:
+                worst = numerics.worst_group()
+            except Exception as e:  # noqa: BLE001 — naming is best-effort
+                logger.warning("guardrails: numerics worst_group failed: "
+                               "%s", e)
+        self._emit(step, verdict, worst_group=worst)
         if verdict.kind == SPIKE:
             logger.warning(
                 "guardrails: spike verdict at step %d (%s: loss=%.6g "
-                "loss_z=%.3g norm_z=%.3g, streak %d/%s)", step,
+                "loss_z=%.3g norm_z=%.3g%s, streak %d/%s)", step,
                 verdict.reason, lossf, verdict.loss_z, verdict.norm_z,
+                f", worst layer group '{worst}'" if worst else "",
                 (self.policy.spike_streak + 1) if self.policy else 1,
                 self.policy.consecutive_spikes if self.policy else "-")
+            if numerics is not None:
+                self._write_spike_dump(engine, step, verdict, worst,
+                                       numerics)
             if self.policy is not None and self.policy.note_spike():
                 # Recovery is not a step: a disk-escalation restore or a
                 # long loader skip must not trip the step deadline and
@@ -174,7 +197,8 @@ class Guardrails:
         return False
 
     # ------------------------------------------------------------------
-    def _emit(self, step: int, verdict: Verdict) -> None:
+    def _emit(self, step: int, verdict: Verdict,
+              worst_group: Optional[str] = None) -> None:
         tel = self.telemetry
         if tel is None or not tel.enabled:
             return
@@ -186,8 +210,45 @@ class Guardrails:
             reg.gauge("guardrails/grad_norm_zscore").set(
                 _finite(verdict.norm_z), step=step)
         if verdict.kind == SPIKE:
+            extra = ({"worst_group": worst_group} if worst_group else {})
             tel.instant("guardrails_spike", step=step, reason=verdict.reason,
-                        loss_z=_finite(verdict.loss_z))
+                        loss_z=_finite(verdict.loss_z), **extra)
+
+    def _write_spike_dump(self, engine, step: int, verdict: Verdict,
+                          worst_group: Optional[str], numerics) -> None:
+        """Spike crashdump: the guardrails-format directory naming the
+        worst layer group plus the full per-group numerics table —
+        "which layer blew up" answered post-mortem, not just in a log
+        line. Bounded by ``telemetry.numerics.max_spike_dumps`` (spikes
+        can streak; disk must not)."""
+        budget = int(getattr(numerics.cfg, "max_spike_dumps", 8))
+        if self._spike_dumps >= budget:
+            return
+        out = os.path.join(self.cfg.watchdog.crashdump_dir,
+                           f"spike_step{step}_{os.getpid()}")
+        try:
+            os.makedirs(out, exist_ok=True)
+            info = {
+                "kind": "spike",
+                "step": int(step),
+                "reason": verdict.reason,
+                "loss_z": _finite(verdict.loss_z),
+                "norm_z": _finite(verdict.norm_z),
+                "worst_group": worst_group,
+                "groups": numerics.group_table(),
+            }
+            with open(os.path.join(out, "info.json"), "w") as f:
+                json.dump(info, f, indent=1)
+            from deepspeed_tpu.telemetry.memory import write_metrics_tail
+            write_metrics_tail(out, self.metrics_path)
+            self._spike_dumps += 1
+            logger.warning("guardrails: spike crashdump written to %s "
+                           "(worst layer group: %s)", out, worst_group)
+        except Exception as e:  # noqa: BLE001 — group_table's device
+            # fetch can raise backend errors exactly when spikes happen
+            # (unhealthy device); a diagnostic dump must never take down
+            # the training loop it diagnoses.
+            logger.warning("guardrails: spike crashdump failed: %s", e)
 
     def _emit_rollback(self, step: int, summary: dict) -> None:
         tel = self.telemetry

@@ -266,7 +266,12 @@ class PipelineEngine(TPUEngine):
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
             state = state._replace(micro_step=state.micro_step + gas,
                                    grad_acc=grads, rng=rng)
-            state, overflow, norm = apply_step(state, lr)
+            out = apply_step(state, lr)
+            state, overflow, norm = out[0], out[1], out[2]
+            if self.numerics is not None:
+                # The shared apply computed the per-group stats (the
+                # "blocks" group covers every pipeline stage).
+                return state, loss, overflow, norm, {"groups": out[3]}
             return state, loss, overflow, norm
 
         def pipe_grad(compute_params, batches_, key, scale):
@@ -295,11 +300,17 @@ class PipelineEngine(TPUEngine):
                 batches=batches, batch_spec=self.batch_spec,
                 compute_params=compute_params, sub=sub, scale=scale,
                 grad_fn=pipe_grad, microbatched=False)
-            grads = plan.sync_grads(stacked, fb_synced)
+            grads, qerr = plan.sync_grads(stacked, fb_synced)
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
             state = state._replace(micro_step=state.micro_step + gas,
                                    grad_acc=grads, rng=rng)
-            state, overflow, norm = apply_step(state, lr)
+            out = apply_step(state, lr)
+            state, overflow, norm = out[0], out[1], out[2]
+            if self.numerics is not None:
+                aux = {"groups": out[3]}
+                if qerr is not None:
+                    aux["dcn_qerr"] = qerr
+                return state, loss, overflow, norm, aux
             return state, loss, overflow, norm
 
         if self._grad_sync_on:
@@ -309,7 +320,8 @@ class PipelineEngine(TPUEngine):
                 grad_template=self.state.grad_acc,
                 grad_specs=self.grad_specs,
                 acc_dtype=self.grad_accum_dtype,
-                ici_dtype=self._comm_dtype, gas=1)
+                ici_dtype=self._comm_dtype, gas=1,
+                measure_quant_error=self.numerics is not None)
             log_dist(self.grad_sync_plan.describe(), ranks=[0])
             train_step = train_step_hierarchical
 

@@ -115,6 +115,20 @@ def _tree_where(pred, a, b):
     return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
 
 
+# ONE process-wide jitted global_norm for the introspection accessor
+# (get_global_grad_norm): jax.jit caches per (fn, signature), so a fresh
+# wrapper per call — the old `jax.jit(global_norm)` inline — re-traced on
+# EVERY invocation. Lazy so importing this module stays backend-free.
+_GLOBAL_NORM_JIT = None
+
+
+def _global_norm_jit():
+    global _GLOBAL_NORM_JIT
+    if _GLOBAL_NORM_JIT is None:
+        _GLOBAL_NORM_JIT = jax.jit(global_norm)
+    return _GLOBAL_NORM_JIT
+
+
 class TPUEngine:
     """The DeepSpeedEngine analogue.
 
@@ -269,6 +283,28 @@ class TPUEngine:
         # --- initial state placement ---------------------------------------
         self.state = self._init_state(params, rng_seed)
 
+        # --- numerics observatory (telemetry/numerics.py) -------------------
+        # Built BEFORE the step functions: the per-layer-group statistics
+        # ride INSIDE the jitted steps (one small stacked aux array), so
+        # the builders below consult `self.numerics`. Disabled (the
+        # default) => None and the builders emit the bit-identical
+        # pre-numerics programs. The telemetry facade attaches later
+        # (construction order), via numerics.attach().
+        from deepspeed_tpu.telemetry.numerics import build_numerics
+        self.numerics = None
+        if not getattr(self.optimizer, "needs_local_grads", False):
+            self.numerics = build_numerics(
+                config.telemetry, params_template=params,
+                compute_dtype=(self.precision.dtype if self.precision.mixed
+                               else None))
+        elif (config.telemetry.enabled
+              and config.telemetry.numerics.enabled):
+            log_dist(
+                "numerics: 1-bit optimizers keep rank-local compressed "
+                "grads inside their own manual region — in-program "
+                "statistics are unavailable on this path; numerics "
+                "observatory disabled", ranks=[0])
+
         # --- jitted step functions -----------------------------------------
         self._donate = donate_state
         self._build_step_fns()
@@ -316,6 +352,11 @@ class TPUEngine:
         from deepspeed_tpu.telemetry import build_telemetry
         self.telemetry = build_telemetry(config.telemetry,
                                          monitor=self.monitor)
+        if self.numerics is not None:
+            # Late binding: the numerics plan had to exist before the
+            # step builders ran; the registry its flush emits into
+            # exists only now.
+            self.numerics.attach(self.telemetry)
         # Goodput accounting (telemetry/goodput.py): attributes every
         # wall-clock second of this attempt to a category and persists the
         # per-attempt run manifest. Disabled => None, and every hook below
@@ -616,6 +657,19 @@ class TPUEngine:
         grad_shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), self.grad_specs)
         scaled_loss_fn = self._make_scaled_loss_fn()
+        # Numerics (telemetry/numerics.py) on the offload tier: grad and
+        # weight stats + dtype counters come from the device-side scan
+        # (new_params stays None — the optimizer step runs on the host,
+        # so update norms are reported as 0). The accumulator is still
+        # loss-scaled here; inv_scale restores unscaled grads, the same
+        # coefficient _make_apply_step uses.
+        nplan = self.numerics.plan if self.numerics is not None else None
+
+        def inv_scale_of(scale):
+            inv = 1.0 / scale
+            if cfg.prescale_gradients:
+                inv = inv * self.dp_size / cfg.gradient_predivide_factor
+            return inv
 
         def finish_scan(acc):
             """Overflow/norm scalars on the fully-reduced accumulator —
@@ -653,6 +707,11 @@ class TPUEngine:
             (acc, rng), losses = jax.lax.scan(body, (zeros, rng), batches)
             acc = jax.lax.with_sharding_constraint(acc, grad_shardings)
             overflow, norm = finish_scan(acc)
+            if nplan is not None:
+                aux = {"groups": nplan.group_stats(
+                    acc, params=compute_params,
+                    inv_scale=inv_scale_of(scale))}
+                return acc, rng, jnp.mean(losses), overflow, norm, aux
             return acc, rng, jnp.mean(losses), overflow, norm
 
         def micro_scan_hierarchical(compute_params, rng, batches, scale):
@@ -667,9 +726,16 @@ class TPUEngine:
                 batches=batches, batch_spec=self.batch_spec,
                 compute_params=compute_params, sub=sub, scale=scale,
                 grad_fn=self._make_micro_grad())
-            acc = plan.sync_grads(stacked, fb_synced)
+            acc, qerr = plan.sync_grads(stacked, fb_synced)
             acc = jax.lax.with_sharding_constraint(acc, grad_shardings)
             overflow, norm = finish_scan(acc)
+            if nplan is not None:
+                aux = {"groups": nplan.group_stats(
+                    acc, params=compute_params,
+                    inv_scale=inv_scale_of(scale))}
+                if qerr is not None:
+                    aux["dcn_qerr"] = qerr
+                return acc, rng, loss, overflow, norm, aux
             return acc, rng, loss, overflow, norm
 
         if self._grad_sync_on:
@@ -682,7 +748,8 @@ class TPUEngine:
                     self._compute_params),
                 grad_specs=self.grad_specs,
                 acc_dtype=self.grad_accum_dtype,
-                ici_dtype=self._comm_dtype, gas=gas)
+                ici_dtype=self._comm_dtype, gas=gas,
+                measure_quant_error=self.numerics is not None)
             log_dist(self.grad_sync_plan.describe(), ranks=[0])
             self._offload_micro_scan = jax.jit(micro_scan_hierarchical)
         else:
@@ -739,8 +806,13 @@ class TPUEngine:
         self._maybe_profile(self._offload_micro_scan, self._compute_params,
                             state.rng, batches, jnp.float32(scale_f),
                             params=self._compute_params)
-        acc, rng, loss, overflow_d, norm_d = self._offload_micro_scan(
+        out = self._offload_micro_scan(
             self._compute_params, state.rng, batches, jnp.float32(scale_f))
+        acc, rng, loss, overflow_d, norm_d = out[:5]
+        if self.numerics is not None:
+            # Device-array hand-off only — the transfer happens at the
+            # flush boundary (the step this aux belongs to commits below).
+            self.numerics.note_step(out[5], self.global_steps + 1)
         grads_h = to_host(acc)
         norm_h = to_host(norm_d)
         overflow_h = (to_host(overflow_d)
@@ -846,6 +918,13 @@ class TPUEngine:
         scaler = self.loss_scaler
 
         nonfinite_check = self._nonfinite_grad_check
+        # Numerics observatory (telemetry/numerics.py): with a plan the
+        # apply returns a 4th output — the [groups, 5] stats aux — so
+        # every builder that routes through this apply (standard,
+        # hierarchical, pipe, and the micro/apply API) computes the
+        # per-group statistics in ONE place. None => the pre-numerics
+        # 3-tuple, bit-identical lowering.
+        nplan = self.numerics.plan if self.numerics is not None else None
 
         def apply_step(state: TrainState, lr):
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
@@ -861,6 +940,7 @@ class TPUEngine:
             overflow = (has_inf_or_nan(grads) if fp16 or nonfinite_check
                         else jnp.zeros((), jnp.bool_))
             norm = global_norm(grads)
+            raw_grads = grads        # pre-clip: the stats want raw norms
             if clip > 0.0:
                 grads = clip_grad_by_global_norm(grads, clip, norm=norm)
             new_params, new_opt = optimizer.update(grads, state.opt_state,
@@ -869,12 +949,19 @@ class TPUEngine:
             new_opt = _tree_where(overflow, state.opt_state, new_opt)
             new_ls = scaler.update(state.loss_scale, overflow)
             zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
-            return state._replace(
+            new_state = state._replace(
                 step=state.step + jnp.where(overflow, 0, 1),
                 params=new_params, opt_state=new_opt, grad_acc=zero_acc,
                 loss_scale=new_ls,
                 skipped_steps=state.skipped_steps + overflow.astype(jnp.int32),
-            ), overflow, norm
+            )
+            if nplan is None:
+                return new_state, overflow, norm
+            # Update norms measure the COMMITTED delta (zero on an
+            # overflow-skipped step, by the _tree_where selection above).
+            stats = nplan.group_stats(raw_grads, params=state.params,
+                                      new_params=new_params)
+            return new_state, overflow, norm, stats
 
         return apply_step
 
@@ -933,7 +1020,11 @@ class TPUEngine:
                 return st, loss
 
             state, losses = jax.lax.scan(body, state, batches)
-            state, overflow, norm = apply_step(state, lr)
+            out = apply_step(state, lr)
+            state, overflow, norm = out[0], out[1], out[2]
+            if self.numerics is not None:
+                return (state, jnp.mean(losses), overflow, norm,
+                        {"groups": out[3]})
             return state, jnp.mean(losses), overflow, norm
 
         def eval_step(state: TrainState, batch):
@@ -982,7 +1073,8 @@ class TPUEngine:
                             grad_template=self.state.grad_acc,
                             grad_specs=self.grad_specs,
                             acc_dtype=self.grad_accum_dtype,
-                            ici_dtype=self._comm_dtype, gas=gas)
+                            ici_dtype=self._comm_dtype, gas=gas,
+                            measure_quant_error=self.numerics is not None)
         self.grad_sync_plan = plan
         log_dist(plan.describe(), ranks=[0])
 
@@ -1003,11 +1095,17 @@ class TPUEngine:
                 batches=batches, batch_spec=self.batch_spec,
                 compute_params=compute_params, sub=sub, scale=scale,
                 grad_fn=micro_grad)
-            grads = plan.sync_grads(stacked, fb_synced)
+            grads, qerr = plan.sync_grads(stacked, fb_synced)
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
             state = state._replace(micro_step=state.micro_step + gas,
                                    grad_acc=grads, rng=rng)
-            state, overflow, norm = apply_step(state, lr)
+            out = apply_step(state, lr)
+            state, overflow, norm = out[0], out[1], out[2]
+            if self.numerics is not None:
+                aux = {"groups": out[3]}
+                if qerr is not None:
+                    aux["dcn_qerr"] = qerr
+                return state, loss, overflow, norm, aux
             return state, loss, overflow, norm
 
         def eval_step(state: TrainState, batch):
@@ -1476,9 +1574,13 @@ class TPUEngine:
                          else contextlib.nullcontext())
             with self.telemetry.span("optimizer_step",
                                      step=self.global_steps), oom_guard:
-                self.state, overflow, norm = self._apply_step(self.state, lr)
+                out = self._apply_step(self.state, lr)
+            self.state, overflow, norm = out[0], out[1], out[2]
             self._micro_in_window = 0
             self.global_steps += 1
+            if self.numerics is not None:
+                self.numerics.note_step({"groups": out[3]},
+                                        self.global_steps)
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
             if self.wall_clock_breakdown:
@@ -1549,6 +1651,13 @@ class TPUEngine:
             # devicetime/* gauges) only at its configured boundaries.
             self.devicetime.step_hook(self.global_steps)
         if self.global_steps % self.steps_per_print == 0:
+            if self.numerics is not None:
+                # THE numerics transfer: one device_get of the stacked
+                # aux, then per-group gauge emission — before tel.flush()
+                # so the rows land in this flush's write, and before the
+                # fleet gather so its grad_norm field reads this flush's
+                # value.
+                self.numerics.flush(self.global_steps)
             tel.flush()
             if self.goodput is not None:
                 # Crash-freshness: a SIGTERM'd attempt keeps a manifest no
@@ -1808,9 +1917,13 @@ class TPUEngine:
         self._maybe_profile(self._train_step, self.state, batches, lr,
                             params=self.state.params)
         with tel.span("train_step", step=self.global_steps) as sp:
-            self.state, loss, overflow, norm = self._train_step(self.state,
-                                                                batches, lr)
+            out = self._train_step(self.state, batches, lr)
+        self.state, loss, overflow, norm = out[:4]
         self.global_steps += 1
+        if self.numerics is not None:
+            # A reference hand-off of the in-program stats aux — the
+            # device->host transfer happens at the flush boundary only.
+            self.numerics.note_step(out[4], self.global_steps)
         self.micro_steps += self.gradient_accumulation_steps
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
@@ -1898,8 +2011,15 @@ class TPUEngine:
             if isinstance(last, tuple):
                 return float(last[0]) * last[1]
             return float(last)
+        # One cached jitted fn for the life of the process: a fresh
+        # jax.jit(global_norm) per call built a new wrapper each time,
+        # re-tracing (and re-compiling) on every invocation. The detector
+        # check makes the regression visible: a retrace under this name
+        # after the first call is a bug (tests/test_numerics.py pins it).
+        self.telemetry.check_recompile("engine.global_norm",
+                                       self.state.grad_acc)
         with self.mesh:
-            return float(jax.jit(global_norm)(self.state.grad_acc))
+            return float(_global_norm_jit()(self.state.grad_acc))
 
     def zero_optimization(self) -> bool:
         return self.config.zero_enabled

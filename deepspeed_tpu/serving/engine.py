@@ -71,7 +71,8 @@ class ServeEngine:
     """
 
     def __init__(self, engine: InferenceEngine, config=None,
-                 telemetry=None, capture_logits: bool = False):
+                 telemetry=None, capture_logits: bool = False,
+                 measure_kv_quant_error: bool = False):
         from deepspeed_tpu.config.config import ServingConfig
         from deepspeed_tpu.telemetry import null_telemetry
 
@@ -112,6 +113,18 @@ class ServeEngine:
 
         self._prefill_jit: Dict[int, Any] = {}
         self._decode_jit = None
+        # Numerics observatory surface (telemetry/numerics.py): with the
+        # int8 KV cache AND the numerics opt-in on
+        # (``telemetry.numerics.enabled`` — init_serving plumbs it;
+        # telemetry-only deployments must not pay a per-prefill measure
+        # inside the TTFT span), each prefill measures the RTNE
+        # round-trip error of the K/V it just quantized into the pool
+        # (one jitted measure per bucket, real positions only) — the
+        # serving analogue of the DCN grad gauge.
+        self._measure_kv = (bool(measure_kv_quant_error)
+                            and bool(self.scfg.int8_kv_cache)
+                            and self.telemetry.enabled)
+        self._kv_err_jit: Dict[int, Any] = {}
         # Donate the pools: decode/pack rewrite them functionally, and
         # without donation XLA double-buffers the whole KV cache (2x HBM)
         # and copies it per token (same rationale as the training
@@ -309,6 +322,8 @@ class ServeEngine:
                                  bucket=bucket, prompt_len=t):
             tok, _logits, ks, vs = self._prefill_jit[bucket](
                 self.engine.params, dev_ids, length, rng)
+            if self._measure_kv:
+                self._emit_kv_quant_error(ks, vs, length, bucket)
             blocks = jnp.asarray(seq.block_table, jnp.int32)
             self._pools = self._pack_jit(self._pools, blocks, ks, vs)
             first = int(tok)                     # host fetch = first token
@@ -382,6 +397,40 @@ class ServeEngine:
         return tok, logits, tuple(c.pools for c in out["cache"])
 
     # -- telemetry ------------------------------------------------------
+    def _emit_kv_quant_error(self, ks, vs, length, bucket: int) -> None:
+        """``numerics/kv_quant_rel_err`` / ``_max_abs_err``: RTNE
+        round-trip error of the per-(token, head) int8 quantization the
+        pool stores (block = head_dim, the quantize_chunk layout),
+        measured over the REAL prompt positions (pads are masked to
+        zero, and zero blocks round-trip exactly — they contribute
+        nothing to either norm). One jitted measure per prompt bucket
+        and ONE device_get for both scalars, on the prefill path that
+        already pays a first-token fetch; gated on the numerics opt-in
+        (``_measure_kv``). The measured evidence behind the int8-KV
+        accuracy/bandwidth trade (docs/OBSERVABILITY.md "Numerics
+        observatory")."""
+        from deepspeed_tpu.comm.quantize import roundtrip_error
+
+        if bucket not in self._kv_err_jit:
+            def measure(ks_, vs_, length_):
+                # ks_/vs_: [L, bucket, H, D]; mask pad positions.
+                mask = (jnp.arange(ks_.shape[1]) < length_)[None, :, None,
+                                                            None]
+                kz = jnp.where(mask, ks_.astype(jnp.float32), 0.0)
+                vz = jnp.where(mask, vs_.astype(jnp.float32), 0.0)
+                head_dim = kz.shape[-1]
+                rk, mk = roundtrip_error(kz, 8, head_dim)
+                rv, mv = roundtrip_error(vz, 8, head_dim)
+                return jnp.maximum(rk, rv), jnp.maximum(mk, mv)
+
+            self._kv_err_jit[bucket] = jax.jit(measure)
+        rel, mab = jax.device_get(self._kv_err_jit[bucket](ks, vs, length))
+        reg = self.telemetry.registry
+        reg.gauge("numerics/kv_quant_rel_err").set(
+            float(rel), step=self._step_count, bucket=bucket)
+        reg.gauge("numerics/kv_quant_max_abs_err").set(
+            float(mab), step=self._step_count, bucket=bucket)
+
     def _emit_step_metrics(self, n_active: int, dt_decode: float) -> None:
         """``dt_decode``: wall seconds of the decode dispatch+fetch only —
         the throughput gauge means DECODE tokens/s, so prefill/admission

@@ -35,6 +35,10 @@ from deepspeed_tpu.telemetry.memory import (MEMORY_METRIC_TAGS,
                                             collect_memory_snapshot,
                                             model_state_ledger,
                                             plan_capacity)
+from deepspeed_tpu.telemetry.numerics import (NUMERICS_METRIC_TAGS,
+                                              NumericsObservatory,
+                                              NumericsPlan,
+                                              build_numerics)
 from deepspeed_tpu.telemetry.recompile import (RECOMPILE_COUNTER,
                                                RecompileDetector,
                                                tree_signature)
@@ -49,10 +53,12 @@ __all__ = [
     "FLEET_METRIC_TAGS", "FleetAggregator", "Gauge",
     "GOODPUT_CATEGORIES", "GOODPUT_METRIC_TAGS", "GoodputAccountant",
     "Histogram", "InMemorySink", "JSONLSink", "MEMORY_METRIC_TAGS",
-    "MemoryObservatory", "MetricsRegistry",
+    "MemoryObservatory", "MetricsRegistry", "NUMERICS_METRIC_TAGS",
+    "NumericsObservatory", "NumericsPlan",
     "RecompileDetector", "RECOMPILE_COUNTER", "Sink", "StepTracer",
     "Telemetry", "TensorboardSink", "build_devicetime", "build_fleet",
-    "build_goodput", "build_memory_observatory", "build_telemetry",
+    "build_goodput", "build_memory_observatory", "build_numerics",
+    "build_telemetry",
     "collect_memory_snapshot", "default_host", "host_scoped_path",
     "model_state_ledger", "null_telemetry", "plan_capacity",
     "telemetry_host_component", "tree_signature",

@@ -60,6 +60,10 @@ FLEET_FIELDS = (
     "hbm_headroom_bytes", # memory observatory headroom (min over devices;
                           # 0 = not reported: telemetry.memory off or no
                           # device bytes_limit)
+    "grad_norm",          # numerics observatory global grad norm at the
+                          # last flush (0 = not reported: telemetry.
+                          # numerics off) — lets stragglers and numeric
+                          # divergence correlate per host
 )
 
 # argmin_host is the headroom field's reason to exist — fleet/
@@ -284,7 +288,7 @@ class FleetAggregator:
         # Committed-step count is authoritative (an engine may note more
         # than one sync'd span per step — e.g. pipe_step + train_step).
         self._steps_delta = d_count if d_count > 0 else 1.0
-        hbm = headroom = 0.0
+        hbm = headroom = grad_norm = 0.0
         tel = self.telemetry
         if tel is not None:
             v = tel.registry.gauge("engine/hbm_peak_bytes").value
@@ -295,6 +299,12 @@ class FleetAggregator:
             # reported", never as "no headroom".
             h = tel.registry.gauge("memory/hbm_headroom_bytes").value
             headroom = float(h) if h else 0.0
+            # Set by the numerics observatory just before this gather
+            # (the engine flushes numerics first); already sanitised to
+            # a finite value there, but guard anyway — one NaN row would
+            # poison every host's median.
+            g = tel.registry.gauge("numerics/global_grad_norm").value
+            grad_norm = float(g) if g and np.isfinite(g) else 0.0
         return np.array([
             step_time,
             max(0.0, cur["data_stall"] - prev["data_stall"]),
@@ -302,6 +312,7 @@ class FleetAggregator:
             max(0.0, cur["productive"] - prev["productive"]),
             max(0.0, cur["exposed"] - prev["exposed"]),
             headroom,
+            grad_norm,
         ], np.float32)
 
     # -- the flush-boundary hook ----------------------------------------

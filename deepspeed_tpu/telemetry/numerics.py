@@ -1,0 +1,330 @@
+"""Numerics observatory — per-layer-group gradient/update statistics,
+dtype-saturation counters, and quantization-error attribution.
+
+The stack measures every second (goodput), byte (comm gauges) and HBM
+allocation (memory observatory) — this module measures the *numbers*
+(docs/OBSERVABILITY.md "Numerics observatory"). Until it landed, the
+guardrails detector saw only scalar loss and one global grad norm, and
+both int8 wire paths (the DCN grad all-reduce, the paged KV cache)
+shipped off-by-default with their error unmeasured — exactly the
+observability ROADMAP item 2 (ZeRO++ qwZ, arXiv 2306.10209) needs before
+a quantized *parameter* all-gather can responsibly turn on, and the
+accuracy/bandwidth trade EQuARX (arXiv 2506.17615) insists must be
+measured, not assumed.
+
+Three tiers behind ``telemetry.numerics`` (default off):
+
+- **In-program statistics** — a :class:`NumericsPlan` groups the param
+  pytree by top-level key (capped at ``max_groups``; the overflow rides
+  an ``_other`` group) and the jitted step computes ONE small stacked
+  ``[groups, 5]`` fp32 aux array: per-group gradient/weight/update
+  squared norms plus compute-dtype saturation (finite fp32 grad → inf in
+  bf16/fp16) and underflow-to-zero (nonzero fp32 grad → exact zero)
+  element counts. All paths — ZeRO 0-3 fused, hierarchical, offload and
+  pipeline — ride the same :meth:`NumericsPlan.group_stats`; the engine
+  stores the device array per step (no transfer) and ONE
+  ``jax.device_get`` at the metrics-flush boundary feeds the
+  ``numerics/*`` gauges. The offload tier's optimizer step runs on the
+  host, so its update norms are reported as 0 (grad/weight stats and the
+  counters are still in-program).
+- **Quantization-error attribution** — with ``comm.hierarchical`` int8
+  (or bf16) on, the DCN stage additionally emits per-bucket RTNE
+  round-trip error of the wire payload against the fp32 shard
+  (``numerics/dcn_quant_rel_err`` / ``numerics/dcn_quant_max_abs_err``,
+  via :func:`deepspeed_tpu.comm.quantize.roundtrip_error_parts` psum'd
+  across the manual region), and the serving engine emits the analogous
+  ``numerics/kv_quant_rel_err`` for the int8 KV cache — the measured
+  evidence the quantized param all-gather decision needs.
+- **Integration** — guardrails spike verdicts name the worst-offending
+  layer group (nonfinite grad first, else largest grad/weight norm
+  ratio) in the trace instant and a ``spike_step*`` crashdump; the fleet
+  vector gains a ``grad_norm`` field so stragglers and numeric
+  divergence correlate per host; ``tools/numerics_report.py`` renders
+  per-group trend tables and flags monotone update-ratio drift.
+
+Zero-overhead contract (the PR 2/3/5/6/7 gate): default off ⇒
+``engine.numerics`` is ``None``, every hook one attribute check, and the
+lowered step text is bit-identical to a numerics-less config. Enabled,
+the statistics ride the existing jitted step (no extra dispatch, no
+per-step host fetch); the single transfer happens at the flush boundary
+(:meth:`NumericsObservatory._fetch` is the ONE site, so tests count it).
+
+jax/numpy are imported lazily where possible so the telemetry package
+stays importable on jax-less report hosts.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+# Columns of the per-group stats matrix — the wire layout of the step
+# aux array. Append only.
+GRAD_SQ, WEIGHT_SQ, UPDATE_SQ, SATURATED, UNDERFLOWED = range(5)
+N_GROUP_STATS = 5
+
+# Name of the overflow group leaves beyond ``max_groups`` collapse into.
+OTHER_GROUP = "_other"
+
+# Every metric tag this module's surface can emit — the engine-side
+# per-group gauges, the DCN per-bucket quantization-error gauges, and the
+# serving engine's KV-cache analogue (emitted from serving/engine.py but
+# owned by this surface). Pinned against docs/OBSERVABILITY.md in BOTH
+# directions by tests/test_doc_lint.py, like GOODPUT/FLEET/MEMORY tags.
+NUMERICS_METRIC_TAGS = frozenset({
+    "numerics/grad_norm",
+    "numerics/weight_norm",
+    "numerics/update_ratio",
+    "numerics/saturation_count",
+    "numerics/underflow_count",
+    "numerics/global_grad_norm",
+    "numerics/dcn_quant_rel_err",
+    "numerics/dcn_quant_max_abs_err",
+    "numerics/kv_quant_rel_err",
+    "numerics/kv_quant_max_abs_err",
+})
+
+
+def _top_key(path) -> str:
+    """Top-level pytree key of one flattened leaf path."""
+    k = path[0]
+    return str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k))))
+
+
+class NumericsPlan:
+    """Trace-time grouping + the in-program stats function.
+
+    Built once per engine from the param template; :meth:`group_stats`
+    is pure jnp and traces inside the jitted step functions — it never
+    dispatches its own program.
+    """
+
+    def __init__(self, params_template: Any, max_groups: int = 16,
+                 compute_dtype=None):
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(params_template)
+        keys = [_top_key(path) for path, _ in flat]
+        ordered: List[str] = []
+        for k in keys:
+            if k not in ordered:
+                ordered.append(k)
+        if len(ordered) > int(max_groups):
+            # Cap: keep the first max_groups-1 top-level keys, collapse
+            # the tail into _other — the aux array must stay small and
+            # its shape static.
+            self.group_names = ordered[:int(max_groups) - 1] + [OTHER_GROUP]
+        else:
+            self.group_names = ordered
+        index = {n: i for i, n in enumerate(self.group_names)}
+        other = index.get(OTHER_GROUP)
+        self.leaf_group = [index.get(k, other) for k in keys]
+        self.num_groups = len(self.group_names)
+        # Saturation/underflow are measured against this dtype (the
+        # engine's mixed-precision compute dtype); None ⇒ pure-fp32 run,
+        # counters are structurally zero.
+        self.compute_dtype = compute_dtype
+
+    # ------------------------------------------------------------------
+    def group_stats(self, grads: Any, params: Any = None,
+                    new_params: Any = None, inv_scale=None):
+        """The ``[num_groups, N_GROUP_STATS]`` fp32 aux array for one
+        optimizer step. ``grads``: the accumulated grad tree (same
+        structure as the param template). ``params``/``new_params``:
+        pre-/post-update params (``new_params=None`` ⇒ update norms stay
+        0 — the offload tier, whose optimizer runs on the host).
+        ``inv_scale``: multiplier restoring unscaled grads (the fused
+        builders hand over already-unscaled grads and pass None)."""
+        import jax
+        import jax.numpy as jnp
+
+        g_leaves = jax.tree_util.tree_leaves(grads)
+        p_leaves = (jax.tree_util.tree_leaves(params)
+                    if params is not None else [None] * len(g_leaves))
+        n_leaves = (jax.tree_util.tree_leaves(new_params)
+                    if new_params is not None else [None] * len(g_leaves))
+        stats = jnp.zeros((self.num_groups, N_GROUP_STATS), jnp.float32)
+        cdt = self.compute_dtype
+        zero = jnp.float32(0.0)
+        for i, g in enumerate(g_leaves):
+            gid = self.leaf_group[i]
+            g32 = g.astype(jnp.float32)
+            if inv_scale is not None:
+                g32 = g32 * inv_scale
+            p = p_leaves[i]
+            w_sq = (jnp.sum(jnp.square(p.astype(jnp.float32)))
+                    if p is not None else zero)
+            if n_leaves[i] is not None and p is not None:
+                d = n_leaves[i].astype(jnp.float32) - p.astype(jnp.float32)
+                u_sq = jnp.sum(d * d)
+            else:
+                u_sq = zero
+            if cdt is not None and jnp.dtype(cdt) != jnp.float32:
+                gc = g32.astype(cdt)
+                sat = jnp.sum((~jnp.isfinite(gc))
+                              & jnp.isfinite(g32)).astype(jnp.float32)
+                under = jnp.sum((gc == 0)
+                                & (g32 != 0)).astype(jnp.float32)
+            else:
+                sat = under = zero
+            stats = stats.at[gid].add(
+                jnp.stack([jnp.sum(g32 * g32), w_sq, u_sq, sat, under]))
+        return stats
+
+
+class NumericsObservatory:
+    """Host-side facade: stores each step's device aux (no transfer),
+    fetches ONCE at the flush boundary, emits the gauges, and answers the
+    guardrails' "which layer group?" question on spike verdicts."""
+
+    def __init__(self, cfg, plan: NumericsPlan, telemetry=None):
+        self.cfg = cfg
+        self.plan = plan
+        self.telemetry = telemetry
+        self._last: Optional[Any] = None
+        self._last_step = -1
+        self._host: Optional[Dict[str, np.ndarray]] = None
+
+    def attach(self, telemetry) -> None:
+        """Late telemetry binding: the engine builds the plan before its
+        step functions, the telemetry facade after."""
+        self.telemetry = telemetry
+
+    # -- step-path hook (no device work) --------------------------------
+    def note_step(self, aux: Any, step: int) -> None:
+        """Store this step's device aux — a reference hand-off, zero
+        syncs; a stored-but-never-flushed aux is simply dropped."""
+        self._last = aux
+        self._last_step = int(step)
+        self._host = None
+
+    # -- the ONE device->host transfer ----------------------------------
+    def _fetch(self) -> Optional[Dict[str, np.ndarray]]:
+        """THE flush-boundary transfer of this subsystem (single site so
+        the zero-sync test can count every numerics-originated fetch)."""
+        if self._last is None:
+            return None
+        if self._host is None:
+            import jax
+            host = jax.device_get(self._last)
+            self._host = {k: np.asarray(v) for k, v in host.items()}
+        return self._host
+
+    # -- flush-boundary emission ----------------------------------------
+    def flush(self, step: int) -> None:
+        tel = self.telemetry
+        if tel is None or not getattr(tel, "enabled", False):
+            return
+        host = self._fetch()
+        if host is None:
+            return
+        groups = np.asarray(host["groups"], np.float64)
+        reg = tel.registry
+        for gi, name in enumerate(self.plan.group_names):
+            g_norm = float(np.sqrt(max(groups[gi, GRAD_SQ], 0.0))
+                           if np.isfinite(groups[gi, GRAD_SQ])
+                           else groups[gi, GRAD_SQ])
+            w_norm = float(np.sqrt(max(groups[gi, WEIGHT_SQ], 0.0)))
+            u_norm = float(np.sqrt(max(groups[gi, UPDATE_SQ], 0.0)))
+            reg.gauge("numerics/grad_norm").set(g_norm, step=step,
+                                                group=name)
+            reg.gauge("numerics/weight_norm").set(w_norm, step=step,
+                                                  group=name)
+            # A relative measure needs a scale: a ~zero-weight group
+            # (zero-init bias under LR warmup) would otherwise report a
+            # meaningless ~1e9 ratio and trip the report's drift flag.
+            reg.gauge("numerics/update_ratio").set(
+                u_norm / w_norm if w_norm > 1e-8 else 0.0,
+                step=step, group=name)
+            reg.gauge("numerics/saturation_count").set(
+                float(groups[gi, SATURATED]), step=step, group=name)
+            reg.gauge("numerics/underflow_count").set(
+                float(groups[gi, UNDERFLOWED]), step=step, group=name)
+        total = float(np.sum(groups[:, GRAD_SQ]))
+        # The fleet vector reads this gauge (FLEET_FIELDS grad_norm) —
+        # keep it finite so a NaN step cannot poison the gather matrix.
+        reg.gauge("numerics/global_grad_norm").set(
+            float(np.sqrt(total)) if np.isfinite(total) and total >= 0
+            else 0.0, step=step)
+        qerr = host.get("dcn_qerr")
+        if qerr is not None and np.size(qerr):
+            qerr = np.asarray(qerr, np.float64)
+            for b in range(qerr.shape[0]):
+                reg.gauge("numerics/dcn_quant_rel_err").set(
+                    float(qerr[b, 0]), step=step, bucket=b)
+                reg.gauge("numerics/dcn_quant_max_abs_err").set(
+                    float(qerr[b, 1]), step=step, bucket=b)
+
+    # -- guardrails integration ------------------------------------------
+    def worst_group(self) -> Optional[str]:
+        """The layer group a spike verdict should name: the first group
+        with a nonfinite gradient norm, else the group with the largest
+        grad-to-weight norm ratio (scale-aware — a raw grad-norm argmax
+        would always name the biggest layer). Costs one transfer; called
+        only on (rare) spike verdicts."""
+        host = self._fetch()
+        if host is None:
+            return None
+        groups = np.asarray(host["groups"], np.float64)
+        names = self.plan.group_names
+        finite = np.isfinite(groups[:, GRAD_SQ])
+        if not finite.all():
+            return names[int(np.argmin(finite))]
+        denom = np.sqrt(np.maximum(groups[:, WEIGHT_SQ], 1e-24))
+        score = np.sqrt(np.maximum(groups[:, GRAD_SQ], 0.0)) / denom
+        return names[int(np.argmax(score))]
+
+    def group_table(self) -> List[Dict[str, Any]]:
+        """Per-group rows for the spike crashdump (floats sanitised for
+        JSON: nonfinite values become the string "nonfinite")."""
+        host = self._fetch()
+        if host is None:
+            return []
+        groups = np.asarray(host["groups"], np.float64)
+
+        def _f(x):
+            x = float(x)
+            return x if np.isfinite(x) else "nonfinite"
+
+        rows = []
+        for gi, name in enumerate(self.plan.group_names):
+            g_sq = groups[gi, GRAD_SQ]
+            rows.append({
+                "group": name,
+                "grad_norm": _f(np.sqrt(g_sq) if np.isfinite(g_sq)
+                                and g_sq >= 0 else g_sq),
+                "weight_norm": _f(np.sqrt(max(groups[gi, WEIGHT_SQ], 0.0))),
+                "update_ratio": _f(
+                    np.sqrt(max(groups[gi, UPDATE_SQ], 0.0))
+                    / np.sqrt(groups[gi, WEIGHT_SQ])
+                    if groups[gi, WEIGHT_SQ] > 1e-16 else 0.0),
+                "saturated": int(groups[gi, SATURATED])
+                if np.isfinite(groups[gi, SATURATED]) else -1,
+                "underflowed": int(groups[gi, UNDERFLOWED])
+                if np.isfinite(groups[gi, UNDERFLOWED]) else -1,
+                "finite": bool(np.isfinite(g_sq)),
+            })
+        return rows
+
+    @property
+    def last_step(self) -> int:
+        return self._last_step
+
+
+def build_numerics(tcfg, params_template: Any,
+                   compute_dtype=None) -> Optional[NumericsObservatory]:
+    """``None`` unless telemetry AND its numerics block are enabled — the
+    engine hooks gate on ``is None`` (the zero-overhead contract, same
+    shape as goodput/fleet/memory/devicetime)."""
+    if tcfg is None or not tcfg.enabled or not tcfg.numerics.enabled:
+        return None
+    try:
+        plan = NumericsPlan(params_template,
+                            max_groups=tcfg.numerics.max_groups,
+                            compute_dtype=compute_dtype)
+    except Exception as e:  # noqa: BLE001 — observability must never
+        # take down the engine it observes
+        logger.warning("numerics: plan construction failed: %s", e)
+        return None
+    return NumericsObservatory(tcfg.numerics, plan)

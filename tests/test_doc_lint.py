@@ -21,6 +21,7 @@ from deepspeed_tpu.telemetry.devicetime import DEVICETIME_METRIC_TAGS
 from deepspeed_tpu.telemetry.fleet import FLEET_METRIC_TAGS
 from deepspeed_tpu.telemetry.goodput import GOODPUT_METRIC_TAGS
 from deepspeed_tpu.telemetry.memory import MEMORY_METRIC_TAGS
+from deepspeed_tpu.telemetry.numerics import NUMERICS_METRIC_TAGS
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "deepspeed_tpu")
@@ -34,6 +35,7 @@ _FLEET_TOKEN_RE = re.compile(r"fleet/[A-Za-z_]+")
 _MEMORY_TOKEN_RE = re.compile(r"memory/[A-Za-z_]+")
 _SERVING_TOKEN_RE = re.compile(r"serving/[A-Za-z_]+")
 _DEVICETIME_TOKEN_RE = re.compile(r"devicetime/[A-Za-z_]+")
+_NUMERICS_TOKEN_RE = re.compile(r"numerics/[A-Za-z_]+")
 
 
 def _iter_py_files():
@@ -161,6 +163,45 @@ class TestDocDrift:
         # enforcement (it is a DEVICETIME_METRIC_TAGS member)
         assert "comm/measured_exposed_frac" in DEVICETIME_METRIC_TAGS
         assert "comm/measured_exposed_frac" in doc
+
+    def test_numerics_tags_documented_and_vice_versa(self):
+        """The numerics surface (telemetry/numerics.py) is pinned in
+        BOTH directions like goodput/fleet/memory/devicetime: every tag
+        the observatory surface can emit — the per-group gauges, the
+        global grad norm, the DCN and KV quantization-error gauges —
+        must be in the doc, and every numerics/* token the doc names
+        must be one the code emits."""
+        doc = _doc_text()
+        undocumented = sorted(t for t in NUMERICS_METRIC_TAGS
+                              if t not in doc)
+        assert not undocumented, undocumented
+        doc_tokens = set(_NUMERICS_TOKEN_RE.findall(doc))
+        phantom = sorted(t for t in doc_tokens
+                         if t not in NUMERICS_METRIC_TAGS)
+        assert not phantom, (
+            f"docs/OBSERVABILITY.md names numerics tags the code never "
+            f"emits: {phantom}")
+        # every literal numerics/* emission in the tree is a declared tag
+        emitted = {t for _, _, t in _emitted_literals()
+                   if t.startswith("numerics/")}
+        assert emitted, "the scan must see the numerics emissions"
+        assert emitted <= NUMERICS_METRIC_TAGS, (
+            emitted - NUMERICS_METRIC_TAGS)
+
+    def test_numerics_report_tags_in_sync(self):
+        """tools/numerics_report.py is stdlib-only by design (no package
+        import), so its private tag tuples are pinned here instead —
+        every numerics/* literal the report reads must be one the
+        observatory surface emits."""
+        with open(os.path.join(REPO, "tools", "numerics_report.py")) as f:
+            src = f.read()
+        report_tags = set(re.findall(r'"(numerics/[A-Za-z_]+)"', src))
+        assert report_tags, "scan must see numerics_report's tags"
+        phantom = sorted(t for t in report_tags
+                         if t not in NUMERICS_METRIC_TAGS)
+        assert not phantom, (
+            f"tools/numerics_report.py reads tags the code never emits: "
+            f"{phantom} — keep it in sync with telemetry/numerics.py")
 
     def test_devicetime_report_tags_in_sync(self):
         """tools/devicetime_report.py is stdlib-only by design (it loads

@@ -120,11 +120,11 @@ class TestAggregator:
     HOSTS = ["hostA", "hostB", "hostC"]
 
     def _matrix(self, step_times, stall=0.1, hbm=1000.0, prod=1.0,
-                exposed=0.05, headroom=500.0):
+                exposed=0.05, headroom=500.0, grad_norm=0.14):
         # headroom decreases with host index: the LAST host is the
         # tightest (argmin names it).
         return np.array([[st, stall, hbm * (i + 1), prod, exposed,
-                          headroom / (i + 1)]
+                          headroom / (i + 1), grad_norm]
                          for i, st in enumerate(step_times)], np.float32)
 
     def test_stats_and_argmax_emitted(self, tmp_path):

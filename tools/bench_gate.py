@@ -229,6 +229,22 @@ def _selftest() -> int:
     assert rep["ok"]
     assert "serving/tokens_per_sec" in rep["missing"]
     assert "gpt2/extra_row" in rep["new_metrics"]
+    # 4b. a whole ADDED section (new bench row family absent from the
+    #     baseline — e.g. a PR that grows bench.py a numerics section) is
+    #     informational, never a regression: the gate stays green and the
+    #     rows surface under new_metrics so --update-baseline adopts them
+    #     deliberately.
+    added = {"sections": {
+        "gpt2": {"tokens_per_sec": 147691.0, "mfu": 0.60},
+        "serving": {"tokens_per_sec": 900.0, "ttft_p50_ms": 12.0},
+        "numerics_probe": {"overhead_x": 1.02, "flush_fetch_ms": 0.4},
+    }}
+    rep = compare(baseline, added)
+    assert rep["ok"] and rep["n_regressions"] == 0, rep
+    assert "numerics_probe/overhead_x" in rep["new_metrics"], rep
+    assert "numerics_probe/flush_fetch_ms" in rep["new_metrics"], rep
+    text_added = render(rep)
+    assert "new in candidate" in text_added and "GATE: ok" in text_added
     # 5. legacy flat-key bench JSONs map onto sections
     legacy = sections_of({"value": 532.98, "gpt2_tokens_per_sec": 147691.0,
                           "serving_ttft_p50_ms": 9.1, "metric": "x",

@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Render a run's numerics observatory metrics from its telemetry JSONL.
+
+The numerics-side companion of goodput_report/fleet_report/memory_report
+(docs/OBSERVABILITY.md "Numerics observatory"): feed it the run dir (the
+job's ``telemetry.dir``) or metrics file(s) and it aggregates the
+``numerics/*`` rows the engine emits —
+
+- **per-layer-group trend table**: latest gradient norm, weight norm,
+  update-to-weight ratio and dtype saturation/underflow counts per
+  group, with the first->last update-ratio trajectory over the run;
+- **monotone update-ratio drift flags**: a group whose update-to-weight
+  ratio moves monotonically (non-decreasing or non-increasing, with at
+  least one strict move) across >= ``--drift-window`` flushes AND by
+  more than ``--drift-factor`` x overall is flagged — the slow-burn
+  instability signature (a param tier decoupling from its gradient
+  scale) that a single-step spike detector cannot see;
+- **quantization-error table**: latest per-bucket DCN round-trip error
+  (``numerics/dcn_quant_rel_err`` / ``_max_abs_err``) and per-bucket KV
+  cache error (``numerics/kv_quant_rel_err``) — the measured
+  accuracy/bandwidth evidence for the int8 wire paths;
+- nonfinite values (a NaN'd group's gauges) are surfaced, never hidden.
+
+    python tools/numerics_report.py /runs/exp17/telemetry
+    python tools/numerics_report.py /runs/exp17/telemetry --json
+    python tools/numerics_report.py --selftest
+
+Standalone on purpose: stdlib only, so it runs anywhere the run dir
+lands (including hosts without jax installed). Keep the tag strings in
+sync with deepspeed_tpu/telemetry/numerics.py NUMERICS_METRIC_TAGS —
+tests/test_doc_lint.py pins them.
+"""
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List, Tuple
+
+DEFAULT_METRICS_FILE = "metrics.jsonl"
+
+# Per-group gauges (tagged group=<name>), in table-column order.
+GROUP_TAGS = (
+    "numerics/grad_norm",
+    "numerics/weight_norm",
+    "numerics/update_ratio",
+    "numerics/saturation_count",
+    "numerics/underflow_count",
+)
+# Per-bucket quantization-error gauges (tagged bucket=<i>).
+QUANT_TAGS = (
+    "numerics/dcn_quant_rel_err",
+    "numerics/dcn_quant_max_abs_err",
+    "numerics/kv_quant_rel_err",
+    "numerics/kv_quant_max_abs_err",
+)
+GLOBAL_TAGS = ("numerics/global_grad_norm",)
+
+
+def _metric_files(path: str) -> List[str]:
+    """A metrics file, or every (possibly host-scoped) metrics*.jsonl
+    under a run dir — the fleet_report convention."""
+    if os.path.isfile(path):
+        return [path]
+    pattern = os.path.join(path, "metrics*.jsonl")
+    return sorted(glob.glob(pattern))
+
+
+def load_rows(paths: List[str]) -> List[Dict[str, Any]]:
+    rows: List[Dict[str, Any]] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    tag = row.get("tag", "")
+                    if tag.startswith("numerics/"):
+                        rows.append(row)
+        except OSError:
+            continue
+    return rows
+
+
+def _series(rows: List[Dict[str, Any]], tag: str,
+            key: str) -> Dict[Any, List[Tuple[int, float]]]:
+    """tag rows -> {key_value: [(step, value), ...] sorted by step}."""
+    out: Dict[Any, List[Tuple[int, float]]] = {}
+    for r in rows:
+        if r.get("tag") != tag or key not in r:
+            continue
+        out.setdefault(r[key], []).append(
+            (int(r.get("step", 0)), float(r.get("value", 0.0))))
+    for v in out.values():
+        v.sort(key=lambda t: t[0])
+    return out
+
+
+def detect_drift(values: List[float], window: int = 4,
+                 factor: float = 2.0) -> bool:
+    """Monotone update-ratio drift: over the last ``window`` (or more)
+    observations the series never reverses direction, moves strictly at
+    least once, and the overall multiplicative change exceeds
+    ``factor`` (or falls below 1/factor). Nonfinite values disable the
+    verdict — a NaN'd group is a spike story, not a drift story."""
+    tail = values[-max(int(window), 2):]
+    if len(tail) < max(int(window), 2):
+        return False
+    if any(not math.isfinite(v) for v in tail):
+        return False
+    diffs = [b - a for a, b in zip(tail, tail[1:])]
+    up = all(d >= 0 for d in diffs) and any(d > 0 for d in diffs)
+    down = all(d <= 0 for d in diffs) and any(d < 0 for d in diffs)
+    if not (up or down):
+        return False
+    lo, hi = tail[0], tail[-1]
+    if up:
+        return hi > lo * factor if lo > 0 else hi > 0
+    return lo > hi * factor if hi > 0 else lo > 0
+
+
+def build_report(rows: List[Dict[str, Any]], window: int = 4,
+                 factor: float = 2.0) -> Dict[str, Any]:
+    groups: Dict[str, Dict[str, Any]] = {}
+    per_tag = {tag: _series(rows, tag, "group") for tag in GROUP_TAGS}
+    names = sorted({g for s in per_tag.values() for g in s})
+    for name in names:
+        row: Dict[str, Any] = {"group": name}
+        for tag in GROUP_TAGS:
+            series = per_tag[tag].get(name, [])
+            short = tag.split("/", 1)[1]
+            row[short] = series[-1][1] if series else None
+            if tag == "numerics/update_ratio" and series:
+                vals = [v for _, v in series]
+                row["update_ratio_first"] = vals[0]
+                row["update_ratio_drift"] = detect_drift(
+                    vals, window=window, factor=factor)
+                row["observations"] = len(vals)
+        row["nonfinite"] = any(
+            row.get(t.split("/", 1)[1]) is not None
+            and not math.isfinite(row[t.split("/", 1)[1]])
+            for t in GROUP_TAGS)
+        groups[name] = row
+    quant: Dict[str, Dict[Any, float]] = {}
+    for tag in QUANT_TAGS:
+        series = _series(rows, tag, "bucket")
+        if series:
+            quant[tag] = {b: s[-1][1] for b, s in series.items()}
+    glob_series = _series(
+        [dict(r, _one=1) for r in rows if r.get("tag") in GLOBAL_TAGS],
+        "numerics/global_grad_norm", "_one").get(1, [])
+    drifting = sorted(g for g, r in groups.items()
+                      if r.get("update_ratio_drift"))
+    return {
+        "groups": [groups[n] for n in names],
+        "quant": quant,
+        "global_grad_norm": glob_series[-1][1] if glob_series else None,
+        "drifting_groups": drifting,
+        "n_rows": len(rows),
+    }
+
+
+def _fmt(v, width=11) -> str:
+    if v is None:
+        return f"{'-':>{width}}"
+    if isinstance(v, bool):
+        return f"{('DRIFT' if v else 'ok'):>{width}}"
+    if isinstance(v, float) and not math.isfinite(v):
+        return f"{'nonfinite':>{width}}"
+    return f"{v:>{width}.4g}"
+
+
+def render(report: Dict[str, Any]) -> str:
+    out = ["numerics observatory report", ""]
+    hdr = (f"{'group':<18} {'grad_norm':>11} {'weight_norm':>11} "
+           f"{'upd_ratio':>11} {'ratio_t0':>11} {'sat':>6} {'under':>6} "
+           f"  drift")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for g in report["groups"]:
+        sat = g.get("saturation_count")
+        under = g.get("underflow_count")
+        out.append(
+            f"{g['group']:<18} {_fmt(g.get('grad_norm'))} "
+            f"{_fmt(g.get('weight_norm'))} {_fmt(g.get('update_ratio'))} "
+            f"{_fmt(g.get('update_ratio_first'))} "
+            f"{int(sat) if sat is not None else '-':>6} "
+            f"{int(under) if under is not None else '-':>6} "
+            f"  {'DRIFT' if g.get('update_ratio_drift') else 'ok'}")
+    if report.get("global_grad_norm") is not None:
+        out.append("")
+        out.append(f"global grad norm (last flush): "
+                   f"{report['global_grad_norm']:.6g}")
+    if report["quant"]:
+        out.append("")
+        out.append("quantization round-trip error (last flush, per bucket):")
+        for tag, buckets in sorted(report["quant"].items()):
+            vals = ", ".join(f"[{b}] {v:.4g}"
+                             for b, v in sorted(buckets.items()))
+            out.append(f"  {tag}: {vals}")
+    out.append("")
+    if report["drifting_groups"]:
+        out.append("MONOTONE UPDATE-RATIO DRIFT: "
+                   + ", ".join(report["drifting_groups"])
+                   + " — update/weight scale is walking; check LR "
+                     "schedule / weight decay before it spikes")
+    else:
+        out.append("no monotone update-ratio drift detected")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Selftest
+# ---------------------------------------------------------------------------
+
+def _selftest() -> int:
+    assert detect_drift([1, 2, 4, 9], 4, 2.0)
+    assert detect_drift([8, 4, 2, 1], 4, 2.0)          # downward counts
+    assert not detect_drift([1, 2, 1, 2], 4, 2.0)      # not monotone
+    assert not detect_drift([1.0, 1.1, 1.2, 1.3], 4, 2.0)  # under factor
+    assert not detect_drift([1, 2, 4], 4, 2.0)         # too short
+    assert not detect_drift([1, 2, float("nan"), 9], 4, 2.0)
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "metrics.jsonl")
+        rows = []
+        # `head` drifts monotonically x8; `layer_0` stays flat.
+        for i, step in enumerate((5, 10, 15, 20)):
+            for grp, ratio in (("head", 0.001 * (2 ** i)),
+                               ("layer_0", 0.001)):
+                rows.append({"tag": "numerics/update_ratio", "value": ratio,
+                             "step": step, "kind": "gauge", "group": grp})
+                rows.append({"tag": "numerics/grad_norm", "value": 0.1,
+                             "step": step, "kind": "gauge", "group": grp})
+                rows.append({"tag": "numerics/weight_norm", "value": 1.0,
+                             "step": step, "kind": "gauge", "group": grp})
+                rows.append({"tag": "numerics/saturation_count", "value": 0,
+                             "step": step, "kind": "gauge", "group": grp})
+                rows.append({"tag": "numerics/underflow_count", "value": 2,
+                             "step": step, "kind": "gauge", "group": grp})
+            rows.append({"tag": "numerics/global_grad_norm", "value": 0.14,
+                         "step": step, "kind": "gauge"})
+            rows.append({"tag": "numerics/dcn_quant_rel_err", "value": 0.008,
+                         "step": step, "kind": "gauge", "bucket": 0})
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        report = build_report(load_rows(_metric_files(td)))
+        assert report["drifting_groups"] == ["head"], report
+        head = next(g for g in report["groups"] if g["group"] == "head")
+        assert head["update_ratio_drift"] and head["observations"] == 4
+        flat = next(g for g in report["groups"] if g["group"] == "layer_0")
+        assert not flat["update_ratio_drift"]
+        assert report["quant"]["numerics/dcn_quant_rel_err"][0] == 0.008
+        assert report["global_grad_norm"] == 0.14
+        text = render(report)
+        assert "DRIFT" in text and "head" in text
+        assert "dcn_quant_rel_err" in text
+        # CLI round-trip on the same dir
+        assert main([td]) == 0
+    print("\nselftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?",
+                    help="telemetry run dir or metrics JSONL file")
+    ap.add_argument("--drift-window", type=int, default=4,
+                    help="observations the monotone-drift flag needs "
+                         "(default 4)")
+    ap.add_argument("--drift-factor", type=float, default=2.0,
+                    help="overall change factor that counts as drift "
+                         "(default 2.0)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in check and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.path:
+        ap.error("run dir or metrics file required (or --selftest)")
+    files = _metric_files(args.path)
+    if not files:
+        print(f"no metrics*.jsonl under {args.path}", file=sys.stderr)
+        return 1
+    rows = load_rows(files)
+    if not rows:
+        print(f"no numerics/* rows in {files} — is telemetry.numerics "
+              f"enabled?", file=sys.stderr)
+        return 1
+    report = build_report(rows, window=args.drift_window,
+                          factor=args.drift_factor)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
