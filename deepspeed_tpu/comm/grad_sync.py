@@ -47,6 +47,34 @@ main. ``on`` with fp32 passthrough tracks the implicit path to float
 reduction-ordering (~1 ulp — an explicit slice-wise sum cannot reproduce
 the implicit single-collective summation order bit-for-bit; the parity
 rungs in tests/test_dcn.py pin the bound).
+
+**Overlap mode** (``comm.overlap_grad_sync``, default ``auto`` ≡ on
+whenever the hierarchical sync engages — ROADMAP item 1, T3 arXiv
+2401.16677 / The Big Send-off arXiv 2504.18658): the same wire protocol
+rescheduled so gradient communication overlaps compute instead of
+serializing after it, along two axes (docs/PERFORMANCE.md "Overlapped
+gradient sync"):
+
+1. *Intra-backward ICI overlap* — buckets are leaf-granular and packed
+   in reverse traversal order (the order gradients become ready during
+   backward), so each bucket's reduce-scatter depends only on its own
+   leaves and the latency-hiding scheduler can run bucket k's scatter
+   concurrently with layer k-1's backward. In-tree models additionally
+   plant :func:`comm.overlap.grad_sync_boundary` markers on their layer
+   stacks: a custom_vjp hook per layer group whose backward rule emits
+   the group's data-axis scatter constraint *between* the layer
+   backwards in the traced program (not all trailing).
+2. *Cross-microstep DCN overlap* — instead of one cross-slice
+   all-reduce of the accumulated shard at the GAS boundary, microstep
+   k's bucket contributions are quantized and dispatched over DCN
+   immediately, double-buffered so exactly one reduce is in flight
+   while microstep k+1's fwd/bwd runs; the reduced scattered shards
+   accumulate at the jit level and only the final microstep's reduce is
+   exposed. DCN wire bytes grow by the GAS factor — traded for hiding
+   nearly all of them — and the modeled ``comm/exposed_frac`` accounts
+   for the overlap (:meth:`GradSyncPlan.modeled_exposed_seconds`).
+
+Overlap off keeps the PR-4 single-boundary schedule byte-for-byte.
 """
 
 import math
@@ -135,6 +163,24 @@ def resolve_hierarchical(comm_cfg, mesh: Mesh, *,
     return True, f"auto: dcn={dcn} hierarchical mesh"
 
 
+def resolve_overlap(comm_cfg) -> bool:
+    """Resolve ``comm.overlap_grad_sync`` (auto|on|off, default auto) to
+    a bool. Overlap is a property of the hierarchical sync's schedule,
+    so it only ever takes effect when :func:`resolve_hierarchical`
+    engaged the strategy — the incompatible configurations (1-bit,
+    pipeline stages > 1, sparse embedding grads) are already excluded
+    there and never reach a plan."""
+    from deepspeed_tpu.config.config import ConfigError
+
+    mode = str(getattr(comm_cfg, "overlap_grad_sync", "auto")).lower()
+    if mode == "off":
+        return False
+    if mode in ("auto", "on"):
+        return True
+    raise ConfigError(
+        f"comm.overlap_grad_sync must be auto|on|off, got '{mode}'")
+
+
 def _spec_axes(spec) -> set:
     axes = set()
     for entry in tuple(spec):
@@ -155,7 +201,7 @@ class GradSyncPlan:
 
     def __init__(self, comm_cfg, mesh: Mesh, grad_template: Any,
                  grad_specs: Any, acc_dtype, ici_dtype=None, gas: int = 1,
-                 measure_quant_error: bool = False):
+                 measure_quant_error: bool = False, overlap: bool = False):
         self.mesh = mesh
         self.dcn_size = int(mesh.shape.get(DCN_AXIS, 1))
         self.data_size = int(mesh.shape.get(DATA_AXIS, 1))
@@ -218,27 +264,86 @@ class GradSyncPlan:
         self.total_elems = sum(self.leaf_sizes[i] for i in self.bucketed_idx)
         self.fallback_elems = sum(self.leaf_sizes[i]
                                   for i in self.fallback_idx)
-        # Every bucket is the same padded size, a multiple of
-        # data*dcn*block so the scattered shard splits evenly into
-        # dcn sub-chunks of whole quantization blocks.
+        # Every bucket is padded to a multiple of data*dcn*block so the
+        # scattered shard splits evenly into dcn sub-chunks of whole
+        # quantization blocks.
+        self.overlap = bool(overlap)
         align = self.data_size * self.dcn_size * self.block
         itemsize = jnp.dtype(self.ici_dtype).itemsize
-        raw = max(align, int(comm_cfg.bucket_mb * _MB / itemsize))
-        self.bucket_elems = ((raw + align - 1) // align) * align
-        if self.total_elems:
-            self.num_buckets = max(
-                1, (self.total_elems + self.bucket_elems - 1)
-                // self.bucket_elems)
-            # Shrink a single bucket to the (aligned) payload: tiny models
-            # must not pad to a full bucket_mb of zeros.
-            if self.num_buckets == 1:
-                self.bucket_elems = (
-                    (self.total_elems + align - 1) // align) * align
+        if self.overlap:
+            # Leaf-granular buckets packed in REVERSE traversal order —
+            # the order gradients become ready during backward — so
+            # bucket k's reduce-scatter depends only on its own leaves
+            # (the readiness-ordered dispatch ROADMAP item 1 asks for).
+            # A leaf never straddles buckets; an oversized leaf is its
+            # own bucket.
+            target = max(align, int(comm_cfg.bucket_mb * _MB / itemsize))
+            self.bucket_leaf_idx: List[List[int]] = []
+            cur: List[int] = []
+            cur_sz = 0
+            for i in reversed(self.bucketed_idx):
+                sz = self.leaf_sizes[i]
+                if cur and cur_sz and cur_sz + sz > target:
+                    self.bucket_leaf_idx.append(cur)
+                    cur, cur_sz = [], 0
+                cur.append(i)
+                cur_sz += sz
+            if cur:
+                self.bucket_leaf_idx.append(cur)
+            self.bucket_padded = [
+                max(align,
+                    (sum(self.leaf_sizes[i] for i in b) + align - 1)
+                    // align * align)
+                for b in self.bucket_leaf_idx]
+            self.num_buckets = len(self.bucket_leaf_idx)
+            # Back-compat scalar (describe(), jaxpr size assertions):
+            # the largest bucket.
+            self.bucket_elems = max(self.bucket_padded, default=0)
+            self.padded_elems = sum(self.bucket_padded)
         else:
-            self.num_buckets = 0
-        self.padded_elems = self.num_buckets * self.bucket_elems
+            # PR-4 layout: fixed-size buckets split from one contiguous
+            # flat buffer (leaves may straddle boundaries).
+            raw = max(align, int(comm_cfg.bucket_mb * _MB / itemsize))
+            self.bucket_elems = ((raw + align - 1) // align) * align
+            if self.total_elems:
+                self.num_buckets = max(
+                    1, (self.total_elems + self.bucket_elems - 1)
+                    // self.bucket_elems)
+                # Shrink a single bucket to the (aligned) payload: tiny
+                # models must not pad to a full bucket_mb of zeros.
+                if self.num_buckets == 1:
+                    self.bucket_elems = (
+                        (self.total_elems + align - 1) // align) * align
+            else:
+                self.num_buckets = 0
+            self.padded_elems = self.num_buckets * self.bucket_elems
+            self.bucket_leaf_idx = []
+            self.bucket_padded = [self.bucket_elems] * self.num_buckets
+        # Top-level param group -> all-bucketed? — consulted by the
+        # ICI overlap hook (comm/overlap.py): a group with any fallback
+        # leaf (non-data sharding) cannot take a flat data constraint.
+        self._group_bucketed = {}
+        try:
+            paths = jax.tree_util.tree_flatten_with_path(grad_template)[0]
+        except Exception:  # noqa: BLE001 — exotic pytrees: hooks just no-op
+            paths = []
+        groups: dict = {}
+        for idx, (path, _) in enumerate(paths):
+            if not path:
+                continue
+            k = path[0]
+            key = getattr(k, "key", None)
+            if key is None:
+                key = getattr(k, "name", None)
+            if key is None:
+                continue
+            groups.setdefault(str(key), []).append(idx)
+        bucketed_set = set(self.bucketed_idx)
+        self._group_bucketed = {
+            k: all(i in bucketed_set for i in v) for k, v in groups.items()}
         self._data_sharding = NamedSharding(mesh, P(DATA_AXIS))
         self._dcn_sync_fn = None
+        self._dcn_overlap_fn = None
 
     @staticmethod
     def _strip_dcn(spec) -> P:
@@ -305,7 +410,7 @@ class GradSyncPlan:
     # ------------------------------------------------------------------
     # stage 2 (jit level, manual={dcn, data})
     # ------------------------------------------------------------------
-    def _dcn_allreduce_local(self, chunk: jax.Array):
+    def _dcn_allreduce_local(self, chunk: jax.Array, gather_ici: bool = True):
         """Body of the DCN stage for ONE bucket's local scattered shard
         ``chunk`` [bucket_elems / data_size]: all-reduce it across slices
         with the configured wire dtype, return ``(gathered_bucket
@@ -362,6 +467,12 @@ class GradSyncPlan:
                                     tiled=False)
             mine = ag.astype(jnp.float32).reshape(-1)
         err = (err1 + err2) if self.measure_quant else None
+        if not gather_ici:
+            # Overlap mode: keep the reduced chunk as this device's data
+            # shard — the jit-level double-buffered accumulator stays at
+            # 1/data memory and the one all-gather happens at unbucket
+            # time, after the final microstep.
+            return mine, err
         # All-gather the reduced chunk back over ICI: the bucket leaves
         # this region replicated and the engine's grad-spec constraint
         # re-shards it locally (no further traffic).
@@ -418,13 +529,232 @@ class GradSyncPlan:
         return out, None
 
     # ------------------------------------------------------------------
+    # overlap mode (comm.overlap_grad_sync; docs/PERFORMANCE.md
+    # "Overlapped gradient sync")
+    # ------------------------------------------------------------------
+    def microstep_buckets_overlap(self, grads_tree: Any
+                                  ) -> Tuple[jax.Array, ...]:
+        """Per-bucket flat buffers built from ONLY each bucket's own
+        leaves (+ its own padding) — every bucket gets an independent
+        dependency chain, so its data-axis reduce-scatter can start as
+        soon as *its* gradients exist, not when the whole tree does.
+        Runs inside the manual={dcn} region like
+        :meth:`microstep_buckets`."""
+        if not self.num_buckets:
+            return ()
+        leaves = self.treedef.flatten_up_to(grads_tree)
+        out = []
+        for lidx, padded in zip(self.bucket_leaf_idx, self.bucket_padded):
+            parts = [leaves[i].reshape(-1).astype(self.ici_dtype)
+                     for i in lidx if self.leaf_sizes[i]]
+            have = sum(self.leaf_sizes[i] for i in lidx)
+            if padded - have:
+                # Padding joins the concat (jnp.pad trips the old
+                # partitioner's manual-subgroup check — see
+                # microstep_buckets).
+                parts.append(jnp.zeros((padded - have,), self.ici_dtype))
+            out.append(jax.lax.with_sharding_constraint(
+                jnp.concatenate(parts) if len(parts) > 1 else parts[0],
+                self._data_sharding))
+        return tuple(out)
+
+    def _dcn_sync_overlap(self, stacked: Tuple[jax.Array, ...]):
+        """Overlap-mode DCN stage for ONE microstep's buckets: same wire
+        protocol as :meth:`dcn_sync` but the reduced buckets come back
+        as data-sharded shards (``gather_ici=False`` — the jit-level
+        accumulator keeps the 1/data memory shape and the single
+        all-gather happens at unbucket time), and the quantization-error
+        accumulables come back raw (``[num_buckets, 6]`` of
+        (err_sq, ref_sq, max_abs) x two hops, already psum/pmax'd over
+        the region) so the caller can accumulate them across
+        microsteps."""
+        if not stacked:
+            return (), None
+        if self._dcn_overlap_fn is None:
+            measure = self.measure_quant
+
+            def body(*bs):
+                res = [self._dcn_allreduce_local(b[0], gather_ici=False)
+                       for b in bs]
+                bufs = tuple(r[0] for r in res)
+                if not measure:
+                    return bufs
+                axes = (DCN_AXIS, DATA_AXIS)
+                rows = []
+                for _, (e1, r1, m1, e2, r2, m2) in res:
+                    rows.append(jnp.stack(
+                        [jax.lax.psum(e1, axes), jax.lax.psum(r1, axes),
+                         jax.lax.pmax(m1, axes),
+                         jax.lax.psum(e2, axes), jax.lax.psum(r2, axes),
+                         jax.lax.pmax(m2, axes)]))
+                return bufs, jnp.stack(rows)
+
+            out_specs = tuple(P(DATA_AXIS) for _ in stacked)
+            if measure:
+                out_specs = (out_specs, P())
+            self._dcn_overlap_fn = shard_map(
+                body, mesh=self.mesh,
+                in_specs=tuple(P(DCN_AXIS, DATA_AXIS) for _ in stacked),
+                out_specs=out_specs,
+                axis_names={DCN_AXIS, DATA_AXIS},
+                check_vma=False)
+        out = self._dcn_overlap_fn(*stacked)
+        if self.measure_quant:
+            return out[0], out[1]
+        return out, None
+
+    def _qerr_from_parts(self, acc: jax.Array) -> jax.Array:
+        """Fold microstep-accumulated error parts ``[num_buckets, 6]``
+        into the ``[num_buckets, 2]`` (rel-L2, max-abs) rows
+        :meth:`dcn_sync` emits: per-hop rel from the summed squares
+        (error-propagation across microsteps), hops RSS-combined;
+        max-abs sums hops AND microsteps (the worst-case errors of the
+        summed contributions add)."""
+        rel1 = rel_from_parts(acc[:, 0], acc[:, 1])
+        rel2 = rel_from_parts(acc[:, 3], acc[:, 4])
+        return jnp.stack(
+            [jnp.sqrt(rel1 * rel1 + rel2 * rel2), acc[:, 2] + acc[:, 5]],
+            axis=1)
+
+    def _microstep_region(self, *, compute_params, sub, scale, batch,
+                          batch_spec, grad_fn, microbatched: bool):
+        """ONE microstep's manual={dcn} region: fwd/bwd with the ICI
+        overlap hook installed (in-tree models' bucket-boundary markers
+        reduce-scatter each layer group's grads mid-backward), per-bucket
+        flat buffers with independent dependency chains, per-microstep
+        fallback sync, dcn-pmean'd loss. Returns ``(stacked_buckets,
+        fb_synced, loss)`` with the buckets dcn-stacked for
+        :meth:`_dcn_sync_overlap`."""
+        from deepspeed_tpu.comm import overlap as overlap_mod
+
+        hook = overlap_mod.ici_scatter_hook(
+            self._data_sharding, self.ici_dtype,
+            lambda name: self._group_bucketed.get(name, False))
+
+        def body(cp, sub_, scale_, batch_, slice_id):
+            key = jax.random.fold_in(sub_, slice_id[0])
+            with overlap_mod.install_ici_hook(hook):
+                loss, grads = grad_fn(cp, batch_, key, scale_)
+            mb = self.microstep_buckets_overlap(grads)
+            fb_synced = self.fallback_sync(self.fallback_leaves(grads))
+            loss = jax.lax.pmean(loss, DCN_AXIS)
+            return tuple(b[None] for b in mb), fb_synced, loss
+
+        batch_specs = dcn_batch_leaf_specs(
+            batch, batch_spec, self.mesh,
+            leading_gas_dim=not microbatched)
+        rep = P()
+        mapped = shard_map(
+            body, mesh=self.mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: rep,
+                                             compute_params),
+                      rep, rep, batch_specs, P(DCN_AXIS)),
+            out_specs=(tuple(P(DCN_AXIS)
+                             for _ in range(self.num_buckets)),
+                       [rep] * len(self.fallback_idx), rep),
+            axis_names={DCN_AXIS},
+            check_vma=False)
+        return mapped(compute_params, sub, scale, batch,
+                      slice_index_operand(self.mesh))
+
+    def _run_overlap_gas(self, *, batches: Any, batch_spec,
+                         compute_params: Any, sub: jax.Array,
+                         scale: jax.Array, grad_fn,
+                         microbatched: bool = True):
+        """The overlapped GAS schedule: microstep k's buckets are
+        quantized and dispatched over DCN immediately after its
+        backward, double-buffered so exactly ONE reduce is in flight
+        while microstep k+1's fwd/bwd runs (its collective chain has no
+        data dependency on k+1's compute — the latency-hiding scheduler
+        overlaps them; in the traced program the dcn collectives of
+        microstep k sit between microstep k's and k+1's compute, not all
+        trailing). Only the final microstep's reduce is exposed.
+        Returns ``(grads_tree, loss, qerr)``."""
+        steps = self.gas if microbatched else 1
+        keys = jax.random.split(sub, steps)
+        total: Optional[List[jax.Array]] = None
+        inflight: Optional[Tuple[jax.Array, ...]] = None
+        fb_total: Optional[List[jax.Array]] = None
+        err_acc = None
+        losses = []
+        for k in range(steps):
+            batch_k = (jax.tree_util.tree_map(lambda x, k=k: x[k], batches)
+                       if microbatched else batches)
+            stacked_k, fb_k, loss_k = self._microstep_region(
+                compute_params=compute_params, sub=keys[k], scale=scale,
+                batch=batch_k, batch_spec=batch_spec, grad_fn=grad_fn,
+                microbatched=microbatched)
+            losses.append(loss_k)
+            fb_total = (list(fb_k) if fb_total is None
+                        else [a + b for a, b in zip(fb_total, fb_k)])
+            if inflight is not None:
+                # Consume the previous microstep's reduce — by now its
+                # wire time has been hidden behind this microstep's
+                # fwd/bwd. The accumulator holds ONE total plus ONE
+                # in-flight buffer (double-buffered), never more.
+                total = (list(inflight) if total is None
+                         else [t + f for t, f in zip(total, inflight)])
+            inflight, parts = self._dcn_sync_overlap(stacked_k)
+            if parts is not None:
+                err_acc = parts if err_acc is None else err_acc + parts
+        if inflight is not None:
+            total = (list(inflight) if total is None
+                     else [t + f for t, f in zip(total, inflight)])
+        grads = self._unbucket_overlap(total or [], fb_total or [])
+        loss = jnp.mean(jnp.stack(losses))
+        qerr = (self._qerr_from_parts(err_acc)
+                if err_acc is not None else None)
+        return grads, loss, qerr
+
+    def _unbucket_overlap(self, buckets: Sequence[jax.Array],
+                          fb: Sequence[jax.Array]) -> Any:
+        """Slice each bucket's (data-sharded) reduced buffer back into
+        its own leaves — leaves never straddle buckets in overlap mode —
+        and merge the fallback leaves. The accumulated buckets arrive
+        data-sharded; GSPMD inserts the one all-gather where the grad
+        specs need it (same total ICI bytes as the non-overlap return
+        gather)."""
+        out: List[Optional[jax.Array]] = [None] * self.num_leaves
+        for lidx, flat in zip(self.bucket_leaf_idx, buckets):
+            off = 0
+            for i in lidx:
+                size = self.leaf_sizes[i]
+                out[i] = flat[off:off + size].reshape(
+                    self.leaf_shapes[i]).astype(self.acc_dtype)
+                off += size
+        for i, leaf in zip(self.fallback_idx, fb):
+            out[i] = leaf
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    def gas_sync(self, *, batches: Any, batch_spec, compute_params: Any,
+                 sub: jax.Array, scale: jax.Array, grad_fn,
+                 microbatched: bool = True):
+        """The ONE entry every hierarchical grad path calls: run the GAS
+        fwd/bwd + full hierarchical sync under whichever schedule this
+        plan resolved (overlapped or the PR-4 boundary sync) and return
+        ``(grads_tree, loss, qerr)``."""
+        if self.overlap:
+            return self._run_overlap_gas(
+                batches=batches, batch_spec=batch_spec,
+                compute_params=compute_params, sub=sub, scale=scale,
+                grad_fn=grad_fn, microbatched=microbatched)
+        stacked, fb_synced, loss = self.run_manual_gas(
+            batches=batches, batch_spec=batch_spec,
+            compute_params=compute_params, sub=sub, scale=scale,
+            grad_fn=grad_fn, microbatched=microbatched)
+        grads, qerr = self.sync_grads(stacked, fb_synced)
+        return grads, loss, qerr
+
+    # ------------------------------------------------------------------
     # jit level
     # ------------------------------------------------------------------
     def run_manual_gas(self, *, batches: Any, batch_spec,
                        compute_params: Any, sub: jax.Array,
                        scale: jax.Array, grad_fn,
                        microbatched: bool = True):
-        """The ONE manual={dcn} region every hierarchical grad path runs:
+        """The ONE manual={dcn} region every BOUNDARY-schedule (overlap
+        off) hierarchical grad path runs — the overlapped schedule uses
+        per-microstep regions (:meth:`_microstep_region`) instead:
         fold the slice id into the dropout key, run the (Python-unrolled)
         GAS loop of ``grad_fn(compute_params, batch, key, scale) ->
         (loss, grads)`` calls, bucket+accumulate each micro-step's grads
@@ -521,32 +851,44 @@ class GradSyncPlan:
         buckets, qerr = self.dcn_sync(stacked)
         return self.unbucket(buckets, synced_fallback), qerr
 
-    def _per_bucket_dcn_bytes(self) -> int:
-        """Modeled DCN wire bytes for one bucket (both directions) — the
-        ONE formula behind modeled_bytes and the per-bucket trace
-        instants, so the gauge and the instants can never disagree."""
-        shard = self.bucket_elems // self.data_size
+    def _bucket_dcn_bytes(self, elems: int) -> int:
+        """Modeled DCN wire bytes for one bucket of ``elems`` elements
+        (both directions) — the ONE formula behind modeled_bytes and the
+        per-bucket trace instants, so the gauge and the instants can
+        never disagree."""
+        shard = elems // self.data_size
         if self.bits == 32:
             # Passthrough ships the bucket's ICI dtype verbatim (bf16
             # communication_data_type also halves the fp32 passthrough).
             return 2 * shard * jnp.dtype(self.ici_dtype).itemsize
         return 2 * modeled_wire_bytes(shard, self.bits, self.block)
 
+    def _per_bucket_dcn_bytes(self) -> int:
+        return self._bucket_dcn_bytes(self.bucket_elems)
+
     def modeled_bytes(self) -> dict:
         """Per-device per-step wire bytes (modeled; self-shard included,
-        so an upper bound — ratios between tiers are exact)."""
-        per_bucket_dcn = self._per_bucket_dcn_bytes()
-        bytes_dcn = self.num_buckets * per_bucket_dcn
-        bytes_dcn += 2 * 4 * self.fallback_elems      # fp32 psum fallback
+        so an upper bound — ratios between tiers are exact). Overlap
+        mode reduces every microstep's contribution over DCN separately
+        (that is what hides the wire time behind the next microstep's
+        compute), so its DCN bytes — and the fp32 reference on the SAME
+        schedule — carry the GAS factor; the compression ratio between
+        tiers is schedule-invariant."""
+        sync_rounds = self.gas if self.overlap else 1
+        dcn_once = (sum(self._bucket_dcn_bytes(e)
+                        for e in self.bucket_padded)
+                    + 2 * 4 * self.fallback_elems)   # fp32 psum fallback
+        bytes_dcn = sync_rounds * dcn_once
         ici_item = jnp.dtype(self.ici_dtype).itemsize
         # One reduce-scatter per MICRO-step (each gas iteration's bucket
         # constraint) in the ICI dtype, plus one fp32 all-gather of the
-        # dequantized buckets out of the DCN stage per optimizer step.
+        # dequantized buckets out of the DCN stage per optimizer step
+        # (overlap mode defers it to unbucket time — same bytes).
         bytes_ici = (self.gas * self.padded_elems * ici_item
                      + self.padded_elems * 4)
-        fp32_dcn = (self.num_buckets * 2 * 4
-                    * (self.bucket_elems // self.data_size)
-                    + 2 * 4 * self.fallback_elems)
+        fp32_dcn = sync_rounds * (
+            sum(2 * 4 * (e // self.data_size) for e in self.bucket_padded)
+            + 2 * 4 * self.fallback_elems)
         return {
             "bytes_dcn": int(bytes_dcn),
             "bytes_ici": int(bytes_ici),
@@ -556,26 +898,61 @@ class GradSyncPlan:
             "bucket_elems": self.bucket_elems,
             "bucketed_elems": self.total_elems,
             "fallback_elems": self.fallback_elems,
+            "overlap": int(self.overlap),
         }
 
-    def modeled_exposed_seconds(self) -> float:
-        """Modeled EXPOSED collective seconds per optimizer step: this
-        plan's sync fires at the GAS boundary (nothing overlaps it —
-        ROADMAP item 1's premise), so every modeled wire byte is exposed
-        device time at the nominal link bandwidths. The numerator of
-        ``comm/exposed_frac`` and the ``goodput/exposed_comm_sec``
-        sub-attribution; replace with jax.profiler-measured collective
-        time via ``tools/fleet_report.py --profile-dir`` when a profile
-        was captured."""
+    def modeled_wire_seconds(self) -> float:
+        """Total modeled collective seconds per optimizer step at the
+        nominal link bandwidths — the wire time that exists, overlapped
+        or not."""
         m = self.modeled_bytes()
         return (m["bytes_dcn"] / (self.dcn_gbps * 1e9)
                 + m["bytes_ici"] / (self.ici_gbps * 1e9))
 
+    def modeled_exposed_seconds(self,
+                                overlap_budget_seconds: Optional[float]
+                                = None) -> float:
+        """Modeled EXPOSED collective seconds per optimizer step — the
+        numerator of ``comm/exposed_frac`` and the
+        ``goodput/exposed_comm_sec`` sub-attribution.
+
+        Non-overlap schedule: the sync fires at the GAS boundary,
+        nothing overlaps it (ROADMAP item 1's premise) — every modeled
+        wire byte is exposed.
+
+        Overlap schedule (docs/OBSERVABILITY.md "Gradient-sync
+        metrics"): the exposed floor is the final microstep's DCN
+        reduce plus the post-sync all-gather (nothing runs behind
+        them); everything else is hideable behind backward compute.
+        ``overlap_budget_seconds`` is the modeled compute time available
+        to hide behind (the engine passes measured step time minus total
+        wire time); hidden time is capped by it, so a comm-dominated
+        step still reports most of its wire time as exposed. ``None``
+        (no step measured yet, tools) reports the optimistic floor.
+        Replace with jax.profiler-measured collective time
+        (``comm/measured_exposed_frac``) when a profile was captured."""
+        total = self.modeled_wire_seconds()
+        if not self.overlap:
+            return total
+        steps = max(1, self.gas)
+        m = self.modeled_bytes()
+        dcn_final = (m["bytes_dcn"] / steps) / (self.dcn_gbps * 1e9)
+        ag_final = (self.padded_elems * 4) / (self.ici_gbps * 1e9)
+        floor = min(total, dcn_final + ag_final)
+        if overlap_budget_seconds is None:
+            return floor
+        hidden = min(total - floor, max(0.0, overlap_budget_seconds))
+        return total - hidden
+
     def describe(self) -> str:
         m = self.modeled_bytes()
+        if self.overlap:
+            shape = "+".join(str(e) for e in self.bucket_padded) or "0"
+            buckets = f"{self.num_buckets}[{shape}] overlap"
+        else:
+            buckets = f"{self.num_buckets}x{self.bucket_elems}"
         return (f"grad_sync: dcn={self.dcn_size} bits={self.bits} "
-                f"block={self.block} buckets={self.num_buckets}"
-                f"x{self.bucket_elems} ici_dtype="
+                f"block={self.block} buckets={buckets} ici_dtype="
                 f"{jnp.dtype(self.ici_dtype).name} "
                 f"fallback_elems={self.fallback_elems} "
                 f"modeled dcn bytes/step {m['bytes_dcn']} "
@@ -596,12 +973,12 @@ class GradSyncPlan:
                                                 step=step)
         if not getattr(self, "_buckets_announced", False):
             self._buckets_announced = True
-            per_bucket = self._per_bucket_dcn_bytes()
-            for b in range(self.num_buckets):
+            for b, elems in enumerate(self.bucket_padded):
                 telemetry.instant("grad_sync/bucket", index=b,
-                                  elems=self.bucket_elems,
-                                  bytes_dcn=per_bucket,
-                                  bits=self.bits)
+                                  elems=elems,
+                                  bytes_dcn=self._bucket_dcn_bytes(elems),
+                                  bits=self.bits,
+                                  overlap=int(self.overlap))
 
 
 # The ISSUE-facing name: the plan IS the strategy object the engines wire

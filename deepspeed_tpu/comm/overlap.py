@@ -1,0 +1,153 @@
+"""Bucket-boundary gradient-sync markers — the compute/comm overlap hooks.
+
+ROADMAP item 1 (T3, arXiv 2401.16677; The Big Send-off, 2504.18658): the
+hierarchical grad sync's ICI reduce-scatters must start *during* the
+backward pass, not after the full gradient tree materializes. XLA's
+latency-hiding scheduler can only overlap a collective with compute that
+is independent of it — so the per-bucket reduce-scatter has to be
+*emitted* where the bucket's gradients become ready, which is mid-way
+through the backward trace, one layer group at a time.
+
+This module provides the marker the in-tree models plant on their layer
+stacks and the hook protocol the grad-sync plan installs while tracing:
+
+- :func:`grad_sync_boundary` — an identity on a parameter (sub)tree.
+  With no hook installed (every non-overlap path: training with
+  ``comm.hierarchical`` off, inference, serving, init) it returns its
+  input untouched and leaves **zero** trace footprint — lowered programs
+  are bit-identical to a model without markers. With a hook installed it
+  wraps the subtree in a ``jax.custom_vjp`` whose backward rule passes
+  the cotangents (exactly this group's gradients, complete at this point
+  of the backward pass) through the hook — which reduce-scatters them
+  over the ICI ``data`` axis via a sharding constraint, so the collective
+  lands *between* the layer backwards in the traced program instead of
+  trailing all of them.
+
+- :func:`install_ici_hook` — the trace-scoped hook installation the
+  grad-sync plan wraps around its ``grad_fn`` call inside the
+  ``manual={dcn}`` region. The hook MUST only be active inside that
+  region: at the GSPMD-auto top level this jax's partitioner mishandles
+  the replication bookkeeping of a data-only constraint under an
+  unconstrained ``dcn`` axis (measured: grads scaled by the dcn size).
+  Inside the region ``dcn`` is manual and the constraint is exactly the
+  per-bucket reduce-scatter the non-overlap path already emits — just
+  earlier.
+
+- :func:`marked_block` — the one-line flax wrapper the model zoo uses:
+  ``map_variables`` applies :func:`grad_sync_boundary` where the block
+  *reads* its params, which is the only place a cotangent hook lands
+  mid-backward (a wrap at the loss_fn entry would put every marker's
+  backward rule after the whole backward pass — all trailing, nothing
+  overlapped).
+
+The marker's backward also routes the flattened cotangents through one
+``jax.lax.optimization_barrier``: it pins the reduce-scatter against
+being algebraically folded away, and it is the greppable anchor the
+overlap-scheduling tests (tests/test_dcn.py) assert interleaving with.
+"""
+
+import contextlib
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+_TLS = threading.local()
+
+# Hook signature: hook(group_name, cotangent_tree) -> cotangent_tree.
+Hook = Callable[[str, Any], Any]
+
+
+def active_hook() -> Optional[Hook]:
+    return getattr(_TLS, "hook", None)
+
+
+@contextlib.contextmanager
+def install_ici_hook(hook: Optional[Hook]):
+    """Trace-scoped hook installation (thread-local — jit tracing is
+    synchronous per thread). Nestable; ``None`` is a no-op installer so
+    callers don't need to branch."""
+    prev = getattr(_TLS, "hook", None)
+    _TLS.hook = hook
+    try:
+        yield
+    finally:
+        _TLS.hook = prev
+
+
+def grad_sync_boundary(tree: Any, name: str) -> Any:
+    """Identity marker on a parameter (sub)tree, planted where a model
+    consumes the group ``name``'s params. No active hook => returns
+    ``tree`` unchanged (zero trace footprint). With a hook, the backward
+    rule hands this group's cotangents to the hook at the point of the
+    backward trace where they are complete."""
+    hook = active_hook()
+    if hook is None:
+        return tree
+
+    @jax.custom_vjp
+    def marker(t):
+        return t
+
+    def fwd(t):
+        return t, None
+
+    def bwd(_, ct):
+        return (hook(name, ct),)
+
+    marker.defvjp(fwd, bwd)
+    return marker(tree)
+
+
+def marked_block(block_cls, name: str):
+    """Wrap a flax module class so reading its ``params`` collection
+    passes through :func:`grad_sync_boundary` under the group ``name``
+    (the module's top-level param key, e.g. ``h_3``). Identity-valued —
+    init trees, checkpoints, and every non-overlap lowering are
+    unchanged."""
+    import flax.linen as nn
+
+    return nn.map_variables(
+        block_cls, "params",
+        trans_in_fn=lambda p: grad_sync_boundary(p, name),
+        trans_out_fn=lambda p: p,
+        init=True, mutable=True)
+
+
+def ici_scatter_hook(data_sharding, ici_dtype,
+                     group_ok: Callable[[str], bool]) -> Hook:
+    """The hook the grad-sync plan installs: flatten the group's float
+    cotangents, cast to the ICI reduction dtype, constrain to the
+    ``data`` axis (XLA lowers the constraint on the not-yet-reduced
+    gradient sum to a reduce-scatter over ICI — the same lowering the
+    bucket constraints get, emitted mid-backward instead), and slice
+    back. Numerically the identity up to the ici-dtype cast the bucket
+    build applies anyway; groups that fail ``group_ok`` (fallback
+    leaves: non-data shardings cannot take a flat data constraint) pass
+    through untouched."""
+
+    def hook(name: str, ct: Any) -> Any:
+        if not group_ok(name):
+            return ct
+        leaves, tdef = jax.tree_util.tree_flatten(ct)
+        idx = [i for i, leaf in enumerate(leaves)
+               if jnp.issubdtype(leaf.dtype, jnp.floating) and leaf.size]
+        if not idx:
+            return ct
+        flat = jnp.concatenate(
+            [leaves[i].reshape(-1).astype(ici_dtype) for i in idx])
+        flat = jax.lax.with_sharding_constraint(flat, data_sharding)
+        # Anchor: keeps the scatter from folding into the later bucket
+        # concat, and is the marker the scheduling tests grep for.
+        flat = jax.lax.optimization_barrier(flat)
+        out = list(leaves)
+        off = 0
+        for i in idx:
+            n = leaves[i].size
+            out[i] = flat[off:off + n].reshape(
+                leaves[i].shape).astype(leaves[i].dtype)
+            off += n
+        return jax.tree_util.tree_unflatten(tdef, out)
+
+    return hook

@@ -412,6 +412,13 @@ class CommConfig:
     absmax scale granularity).
     ``bucket_mb``: flat gradient bucket size in MiB (the unit of the ICI
     reduce-scatter and DCN all-reduce).
+    ``overlap_grad_sync``: the overlapped schedule (docs/PERFORMANCE.md
+    "Overlapped gradient sync") — readiness-ordered per-bucket ICI
+    reduce-scatter during backward plus a double-buffered per-microstep
+    DCN all-reduce. ``auto`` (default) engages whenever the hierarchical
+    sync does; ``off`` keeps the GAS-boundary schedule; ``on`` is
+    explicit opt-in (same effect as auto — the incompatible paths are
+    already excluded at ``hierarchical`` resolution).
     ``ici_gbps`` / ``dcn_gbps``: nominal per-device link bandwidths behind
     the modeled ``comm/exposed_frac`` device-time attribution
     (docs/OBSERVABILITY.md "Fleet observability").
@@ -421,6 +428,7 @@ class CommConfig:
     dcn_quant_bits: int = C.COMM_DCN_QUANT_BITS_DEFAULT
     quant_block_size: int = C.COMM_QUANT_BLOCK_SIZE_DEFAULT
     bucket_mb: float = C.COMM_BUCKET_MB_DEFAULT
+    overlap_grad_sync: str = C.COMM_OVERLAP_GRAD_SYNC_DEFAULT
     ici_gbps: float = C.COMM_ICI_GBPS_DEFAULT
     dcn_gbps: float = C.COMM_DCN_GBPS_DEFAULT
 
@@ -436,6 +444,9 @@ class CommConfig:
                                       C.COMM_QUANT_BLOCK_SIZE_DEFAULT)),
             bucket_mb=float(_get(d, C.COMM_BUCKET_MB,
                                  C.COMM_BUCKET_MB_DEFAULT)),
+            overlap_grad_sync=str(_get(
+                d, C.COMM_OVERLAP_GRAD_SYNC,
+                C.COMM_OVERLAP_GRAD_SYNC_DEFAULT)).lower(),
             ici_gbps=float(_get(d, C.COMM_ICI_GBPS,
                                 C.COMM_ICI_GBPS_DEFAULT)),
             dcn_gbps=float(_get(d, C.COMM_DCN_GBPS,
@@ -456,6 +467,10 @@ class CommConfig:
         if cfg.bucket_mb <= 0:
             raise ConfigError(
                 f"comm.bucket_mb must be positive, got {cfg.bucket_mb}")
+        if cfg.overlap_grad_sync not in ("auto", "on", "off"):
+            raise ConfigError(
+                f"comm.overlap_grad_sync must be auto|on|off, got "
+                f"'{cfg.overlap_grad_sync}'")
         if cfg.ici_gbps <= 0 or cfg.dcn_gbps <= 0:
             raise ConfigError(
                 f"comm.ici_gbps/dcn_gbps must be positive, got "

@@ -408,6 +408,13 @@ COMM_QUANT_BLOCK_SIZE = "quant_block_size"
 COMM_QUANT_BLOCK_SIZE_DEFAULT = 1024
 COMM_BUCKET_MB = "bucket_mb"
 COMM_BUCKET_MB_DEFAULT = 16.0
+# Overlapped gradient sync (docs/PERFORMANCE.md "Overlapped gradient
+# sync"): readiness-ordered per-bucket ICI reduce-scatter during
+# backward + double-buffered per-microstep DCN all-reduce. "auto"
+# (default) engages whenever the hierarchical sync does; "off" keeps
+# the PR-4 GAS-boundary schedule.
+COMM_OVERLAP_GRAD_SYNC = "overlap_grad_sync"
+COMM_OVERLAP_GRAD_SYNC_DEFAULT = "auto"       # auto | on | off
 # Nominal per-device link bandwidths behind the modeled device-time
 # attribution (comm/exposed_frac): exposed-collective seconds =
 # bytes_dcn / dcn + bytes_ici / ici. Defaults approximate a v4-class

@@ -159,13 +159,19 @@ class BertModel(nn.Module):
         layer = BertLayer
         if cfg.remat:
             layer = nn.remat(BertLayer, static_argnums=(3,))
+        # Bucket-boundary grad-sync markers (comm/overlap.py): see the
+        # GPT stack — identity unless the engine's overlapped grad-sync
+        # plan installs its hook, in which case each layer's grads
+        # reduce-scatter over ICI mid-backward.
+        from deepspeed_tpu.comm.overlap import marked_block
         # Progressive Layer Drop — BERT is the reference's PLD target
         # (progressive_layer_drop.py + the PLD gates in its modeling files):
         # keep prob p_l = 1 - l/L * (1 - theta), theta injected per step by
         # the engine as batch["pld_theta"].
         pld_theta = batch.get("pld_theta")
         for i in range(cfg.num_layers):
-            y = layer(cfg, name=f"layer_{i}")(x, attn_mask, deterministic)
+            y = marked_block(layer, f"layer_{i}")(
+                cfg, name=f"layer_{i}")(x, attn_mask, deterministic)
             if pld_theta is not None and not deterministic:
                 from deepspeed_tpu.runtime.progressive_layer_drop import \
                     pld_keep_gate

@@ -364,6 +364,19 @@ class GPT(nn.Module):
         block = GPTBlock
         if cfg.remat:
             block = nn.remat(GPTBlock, static_argnums=(3,))
+        # Bucket-boundary grad-sync markers (comm/overlap.py): each block
+        # reads its params through an identity marker whose custom_vjp
+        # backward reduce-scatters the block's grads over ICI *between*
+        # the layer backwards — the intra-backward overlap axis of the
+        # overlapped gradient sync (docs/PERFORMANCE.md). Inert (zero
+        # trace footprint) unless the engine's grad-sync plan installs
+        # its hook; wrapping sits OUTSIDE remat so the scatter is not
+        # rematerialized.
+        from deepspeed_tpu.comm.overlap import marked_block
+
+        def layer_block(i):
+            return marked_block(block, f"h_{i}")(
+                cfg, moe=is_moe(i), name=f"h_{i}")
         # Progressive Layer Drop (reference progressive_layer_drop.py +
         # engine hooks): per-step keep prob p_l = 1 - l/L * (1 - theta);
         # the engine injects batch["pld_theta"] when pld.enabled.
@@ -377,13 +390,11 @@ class GPT(nn.Module):
 
         for i in range(cfg.num_layers):
             if cache is not None:
-                out = block(cfg, moe=is_moe(i), name=f"h_{i}")(
-                    x, attn_mask, True, cache[i], pos)
+                out = layer_block(i)(x, attn_mask, True, cache[i], pos)
                 x, layer_kv = out[0], out[1]   # aux (if any) unused in decode
                 new_cache.append(layer_kv)
             else:
-                y = block(cfg, moe=is_moe(i), name=f"h_{i}")(
-                    x, attn_mask, deterministic)
+                y = layer_block(i)(x, attn_mask, deterministic)
                 aux_i = None
                 if is_moe(i):
                     y, aux_i = y

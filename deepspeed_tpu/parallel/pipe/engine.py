@@ -296,11 +296,10 @@ class PipelineEngine(TPUEngine):
             rng, sub = jax.random.split(state.rng)
             compute_params = precision.cast_params(state.params)
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
-            stacked, fb_synced, loss = plan.run_manual_gas(
+            grads, loss, qerr = plan.gas_sync(
                 batches=batches, batch_spec=self.batch_spec,
                 compute_params=compute_params, sub=sub, scale=scale,
                 grad_fn=pipe_grad, microbatched=False)
-            grads, qerr = plan.sync_grads(stacked, fb_synced)
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
             state = state._replace(micro_step=state.micro_step + gas,
                                    grad_acc=grads, rng=rng)
@@ -314,14 +313,20 @@ class PipelineEngine(TPUEngine):
             return state, loss, overflow, norm
 
         if self._grad_sync_on:
-            from deepspeed_tpu.comm.grad_sync import GradSyncPlan
+            from deepspeed_tpu.comm.grad_sync import (GradSyncPlan,
+                                                      resolve_overlap)
+            # gas=1: the pipelined fwd/bwd consumes all microbatches in
+            # ONE grad_fn call, so the cross-microstep DCN overlap axis
+            # is degenerate here; overlap still buys the readiness-
+            # ordered per-bucket scatter chains.
             self.grad_sync_plan = GradSyncPlan(
                 cfg.comm, mesh,
                 grad_template=self.state.grad_acc,
                 grad_specs=self.grad_specs,
                 acc_dtype=self.grad_accum_dtype,
                 ici_dtype=self._comm_dtype, gas=1,
-                measure_quant_error=self.numerics is not None)
+                measure_quant_error=self.numerics is not None,
+                overlap=resolve_overlap(cfg.comm))
             log_dist(self.grad_sync_plan.describe(), ranks=[0])
             train_step = train_step_hierarchical
 

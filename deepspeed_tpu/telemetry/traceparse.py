@@ -258,6 +258,35 @@ def uncovered_time(iv: Tuple[float, float],
     return (e - s) - covered
 
 
+def uncovered_segments(iv: Tuple[float, float],
+                       merged: List[Tuple[float, float]]
+                       ) -> List[Tuple[float, float]]:
+    """The contiguous pieces of ``iv`` not covered by the merged
+    interval union. ``sum(e - s) == uncovered_time(iv, merged)`` by
+    construction; the LONGEST piece is the overlap-quality signal the
+    grad-sync A/B probe reads (tools/probe_comm.py): a GAS-boundary
+    sync exposes one long contiguous collective block, the overlapped
+    schedule splits it into per-microstep slivers."""
+    s, e = iv
+    if e <= s:
+        return []
+    out: List[Tuple[float, float]] = []
+    cur = s
+    for ms, me in merged:
+        if me <= cur:
+            continue
+        if ms >= e:
+            break
+        if ms > cur:
+            out.append((cur, min(ms, e)))
+        cur = max(cur, me)
+        if cur >= e:
+            break
+    if cur < e:
+        out.append((cur, e))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # jax.profiler capture analysis (device-time attribution)
 # ---------------------------------------------------------------------------
@@ -271,6 +300,7 @@ def _empty_analysis() -> Dict[str, Any]:
         "gap_sec": 0.0,
         "collective_sec": 0.0,
         "exposed_collective_sec": 0.0,
+        "max_exposed_segment_sec": 0.0,
         "n_devices": 0,
         "n_events": 0,
         "captures": [],
@@ -352,9 +382,77 @@ def analyze_capture_doc(doc: Dict[str, Any]) -> Dict[str, Any]:
         out["window_sec"] += span
         out["gap_sec"] += max(0.0, span - busy)
         for iv in merge_intervals(collectives):
-            out["exposed_collective_sec"] += uncovered_time(iv, comp_merged)
+            for us, ue in uncovered_segments(iv, comp_merged):
+                out["exposed_collective_sec"] += ue - us
+                out["max_exposed_segment_sec"] = max(
+                    out["max_exposed_segment_sec"], ue - us)
     out["n_devices"] = len(per_pid)
     return out
+
+
+def collective_burstiness(doc: Dict[str, Any], op_filter: str = "all-to-all",
+                          win_frac: float = 0.05) -> float:
+    """How concentrated the matching collectives' wall time is: the max
+    share of their total duration inside any contiguous
+    ``win_frac``-of-capture span (windows anchored at each matching
+    interval's start).
+
+    The overlap A/B's schedule-geometry signal (tools/probe_comm.py): a
+    GAS-boundary grad sync fires its whole DCN stage (`all-to-all`
+    chains) in ONE burst — high burstiness — while the overlapped
+    schedule spreads it across microsteps. Geometry, not contention: it
+    reads event timestamps only, so it stays meaningful on the CPU
+    backend where nothing can truly run concurrently. Returns 0.0 when
+    no op matches."""
+    match: List[Tuple[float, float]] = []
+    allops: List[Tuple[float, float]] = []
+    for ev in (doc.get("traceEvents") or []):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        if classify_op(name) is None:
+            continue
+        try:
+            ts = float(ev.get("ts", 0.0)) / 1e6
+            dur = float(ev.get("dur", 0.0)) / 1e6
+        except (TypeError, ValueError):
+            continue
+        if dur <= 0:
+            continue
+        allops.append((ts, ts + dur))
+        if op_filter in name:
+            match.append((ts, ts + dur))
+    if not match:
+        return 0.0
+    km = merge_intervals(match)
+    am = merge_intervals(allops)
+    window = am[-1][1] - am[0][0]
+    if window <= 0:
+        return 0.0
+    w = window * win_frac
+    total = sum(e - s for s, e in km)
+    best = 0.0
+    for s0, _ in km:
+        inwin = sum(min(e, s0 + w) - max(s, s0)
+                    for s, e in km if e > s0 and s < s0 + w)
+        best = max(best, inwin / total if total else 0.0)
+    return best
+
+
+def collective_burstiness_dir(profile_dir: str,
+                              op_filter: str = "all-to-all",
+                              win_frac: float = 0.05) -> float:
+    """Max :func:`collective_burstiness` over every ``*.trace.json.gz``
+    under ``profile_dir`` (torn captures skipped)."""
+    best = 0.0
+    pattern = os.path.join(profile_dir, "**", "*.trace.json.gz")
+    for path in sorted(_glob.glob(pattern, recursive=True)):
+        try:
+            best = max(best, collective_burstiness(
+                open_trace(path), op_filter=op_filter, win_frac=win_frac))
+        except (OSError, EOFError, ValueError, zlib.error):
+            continue
+    return best
 
 
 def merge_analyses(analyses: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
@@ -370,6 +468,9 @@ def merge_analyses(analyses: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         for k in ("busy_sec", "window_sec", "gap_sec", "collective_sec",
                   "exposed_collective_sec", "n_events"):
             out[k] += a[k]
+        out["max_exposed_segment_sec"] = max(
+            out["max_exposed_segment_sec"],
+            a.get("max_exposed_segment_sec", 0.0))
         out["n_devices"] = max(out["n_devices"], a["n_devices"])
         out["captures"].extend(a.get("captures", []))
     return out

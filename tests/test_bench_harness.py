@@ -92,3 +92,103 @@ class TestRunSection:
             result["row"] = 1.0
 
         assert bench.run_section("s", section, result)
+
+
+class _FlakyEngine:
+    """train_batch raises a transient tunnel error after N good calls."""
+
+    def __init__(self, die_after):
+        self.calls = 0
+        self.die_after = die_after
+
+    def train_batch(self, batches):
+        self.calls += 1
+        if self.calls > self.die_after:
+            raise RuntimeError("remote_compile: read body: closed")
+        return 0.5
+
+
+class TestTransientMidWindowPartial:
+    """The r04 hardening (ISSUE 11 satellite): a transient failure AFTER
+    the first completed window keeps the evidence, stamps the row
+    partial, and the section keeps rc=1 semantics; a failure BEFORE any
+    window still propagates to the retry path."""
+
+    def test_partial_windows_kept_and_row_stamped(self, tmp_path,
+                                                  monkeypatch):
+        bench = _load_bench(tmp_path, monkeypatch)
+        # warmup(1) + fence + window1(2 steps) ok, dies in window2
+        eng = _FlakyEngine(die_after=3)
+        result = {}
+
+        def section():
+            dt, dt_med = bench.time_train_batches(eng, {}, steps=2,
+                                                  warmup=1, windows=3)
+            assert dt > 0 and dt_med > 0
+            bench._section_rows(result, "s", samples_per_sec=1.0 / dt)
+
+        ok = bench.run_section("s", section, result)
+        row = result["sections"]["s"]
+        assert row["partial"] == 1
+        assert row["samples_per_sec"] > 0
+        # evidence recorded, section NOT green (backend-init rc=1 style)
+        assert not ok
+        assert any("partial" in e for e in result["errors"])
+        # flag consumed: the NEXT recorded row is clean
+        bench._section_rows(result, "s2", x=1.0)
+        assert "partial" not in result["sections"]["s2"]
+
+    def test_failure_before_first_window_propagates(self, tmp_path,
+                                                    monkeypatch):
+        bench = _load_bench(tmp_path, monkeypatch)
+        eng = _FlakyEngine(die_after=1)     # dies inside window 1
+        result = {}
+
+        def section():
+            bench.time_train_batches(eng, {}, steps=2, warmup=1, windows=3)
+            bench._section_rows(result, "s", samples_per_sec=1.0)
+
+        ok = bench.run_section("s", section, result)
+        assert not ok                        # transient, retried, dead twice
+        assert "sections" not in result      # no row fabricated
+        assert len(result["errors"]) == 2
+
+    def test_stale_flag_does_not_leak_across_attempts(self, tmp_path,
+                                                      monkeypatch):
+        bench = _load_bench(tmp_path, monkeypatch)
+        result = {}
+        attempt = []
+
+        def section():
+            attempt.append(1)
+            if len(attempt) == 1:
+                # first attempt: timing goes partial, then the section
+                # dies transiently BEFORE recording its row
+                eng = _FlakyEngine(die_after=3)
+                bench.time_train_batches(eng, {}, steps=2, warmup=1,
+                                         windows=3)
+                raise RuntimeError("tunnel connection reset")
+            # retry completes cleanly — its row must NOT be stamped
+            bench._section_rows(result, "s", samples_per_sec=2.0)
+
+        ok = bench.run_section("s", section, result)
+        assert ok
+        assert "partial" not in result["sections"]["s"]
+
+    def test_deterministic_midwindow_failure_still_raises(self, tmp_path,
+                                                          monkeypatch):
+        bench = _load_bench(tmp_path, monkeypatch)
+
+        class Buggy:
+            calls = 0
+
+            def train_batch(self, batches):
+                Buggy.calls += 1
+                if Buggy.calls > 3:
+                    raise ValueError("shape mismatch")   # deterministic
+                return 0.5
+
+        import pytest
+        with pytest.raises(ValueError):
+            bench.time_train_batches(Buggy(), {}, steps=2, warmup=1,
+                                     windows=3)

@@ -99,6 +99,10 @@ def sections_of(doc: Dict[str, Any]) -> Dict[str, Dict[str, float]]:
         if not isinstance(rows, dict):
             continue
         for metric, value in rows.items():
+            if metric == "partial":
+                # Row annotation (bench.py: timing lost windows to a
+                # transient failure), not a metric — never gated.
+                continue
             if isinstance(value, (int, float)) and not isinstance(
                     value, bool):
                 out.setdefault(section, {})[metric] = float(value)
