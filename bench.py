@@ -505,6 +505,14 @@ def main():
         # sections with hierarchical sync on must record its comm block
         # here so BENCH_*.json rows stay attributable.
         "comm": {"hierarchical": "off", "overlap_grad_sync": "off"},
+        # ZeRO++ weight path (zero_optimization.zeropp): the training
+        # sections above run with the block OFF (bit-identical implicit
+        # param path); the zeropp A/B section below measures the
+        # explicit quantized weight gather and records its own config
+        # in its rows. A future PR benching the training sections with
+        # qwZ/hpZ on must record its zeropp block here so BENCH_*.json
+        # rows stay attributable.
+        "zeropp": {"quantized_weights": "off", "hpz": "off"},
         # Serving-section config (docs/SERVING.md): the continuous-
         # batching rows below were measured under exactly this block.
         # Its memory-sink telemetry is scoped to the serving engine and
@@ -646,23 +654,20 @@ def main():
                       ttft_p99_ms=result["serving_ttft_p99_ms"],
                       mean_occupancy=result["serving_mean_occupancy"])
 
-    def sec_comm_overlap():
-        # Overlapped gradient sync A/B (docs/PERFORMANCE.md "Overlapped
-        # gradient sync"): tiny GPT on a 2-slice mesh, hierarchical int8
-        # sync with overlap off vs on. On TPU the overlap hides the DCN
-        # wire time (step time drops); on CPU the section is still a
-        # schedule-correctness row. step-time rows are *_ms so the gate
-        # treats upward drift as regression.
+    def gpt_ab_times(gas, make_config):
+        # Shared 2-slice tiny-GPT A/B harness for the comm_overlap and
+        # zeropp sections: build the model once, then time an off/on
+        # engine pair — make_config(variant) supplies each variant's
+        # config block on top of the common batch/optimizer base.
         import deepspeed_tpu
         from deepspeed_tpu.models import make_gpt
         from deepspeed_tpu.parallel.mesh import build_mesh
 
         import jax.numpy as jnp
 
-        t0 = time.time()
         # micro_bs 1 per chip: the global microbatch is the chip count
         # (put_batch shards over dcn x data).
-        gas, seq, bs = 4, 64 if on_tpu else 32, n_chips_all
+        seq, bs = 64 if on_tpu else 32, n_chips_all
         model, cfg = make_gpt(
             "tiny", dropout_rate=0.0,
             dtype=jnp.bfloat16 if on_tpu else jnp.float32,
@@ -681,16 +686,28 @@ def main():
                     "train_micro_batch_size_per_gpu": 1,
                     "gradient_accumulation_steps": gas,
                     "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-                    "zero_optimization": {"stage": 2},
-                    "comm": {"hierarchical": "on", "dcn_quant_bits": 8,
-                             "quant_block_size": 256,
-                             "overlap_grad_sync": variant},
+                    **make_config(variant),
                 })
-            batch = {"input_ids": ids}
-            dt, _ = time_train_batches(engine, batch, max(steps, 2),
-                                       warmup, windows=2)
+            dt, _ = time_train_batches(engine, {"input_ids": ids},
+                                       max(steps, 2), warmup, windows=2)
             times[variant] = dt / max(steps, 2)
             del engine
+        return times
+
+    def sec_comm_overlap():
+        # Overlapped gradient sync A/B (docs/PERFORMANCE.md "Overlapped
+        # gradient sync"): tiny GPT on a 2-slice mesh, hierarchical int8
+        # sync with overlap off vs on. On TPU the overlap hides the DCN
+        # wire time (step time drops); on CPU the section is still a
+        # schedule-correctness row. step-time rows are *_ms so the gate
+        # treats upward drift as regression.
+        t0 = time.time()
+        times = gpt_ab_times(4, lambda variant: {
+            "zero_optimization": {"stage": 2},
+            "comm": {"hierarchical": "on", "dcn_quant_bits": 8,
+                     "quant_block_size": 256,
+                     "overlap_grad_sync": variant},
+        })
         speedup = times["off"] / times["on"] if times["on"] else 0.0
         log(f"[bench] comm overlap A/B (tiny GPT, 2-slice int8): "
             f"off {times['off'] * 1e3:.1f} ms/step, on "
@@ -703,6 +720,37 @@ def main():
             step_time_overlap_on_ms=round(times["on"] * 1e3, 3),
             overlap_step_speedup=round(speedup, 3))
 
+    def sec_zeropp():
+        # ZeRO++ weight path A/B (docs/PERFORMANCE.md "ZeRO++ weight
+        # path"): tiny GPT stage-3 on a 2-slice mesh, zeropp off vs
+        # qwZ-int8 + hpZ. On TPU the quantized gather cuts the param
+        # all-gather wire time; on CPU the section is a schedule-
+        # correctness row. step-time rows are *_ms so the gate treats
+        # upward drift as regression. The baseline adopts this section
+        # via the documented --update-baseline green-round flow
+        # (tools/bench_gate.py treats a new section as informational
+        # until then).
+        t0 = time.time()
+        times = gpt_ab_times(2, lambda variant: {
+            "zero_optimization": {
+                "stage": 3, "stage3_param_persistence_threshold": 0,
+                **({"zeropp": {"quantized_weights": "int8", "hpz": "on",
+                               "quant_block_size": 256}}
+                   if variant == "on" else {}),
+            },
+        })
+        speedup = times["off"] / times["on"] if times["on"] else 0.0
+        log(f"[bench] zeropp A/B (tiny GPT stage-3, 2-slice): off "
+            f"{times['off'] * 1e3:.1f} ms/step, qwZ-int8+hpZ "
+            f"{times['on'] * 1e3:.1f} ms/step ({speedup:.2f}x, "
+            f"{time.time() - t0:.0f}s)")
+        result["zeropp_step_speedup"] = round(speedup, 3)
+        _section_rows(
+            result, "zeropp",
+            step_time_zeropp_off_ms=round(times["off"] * 1e3, 3),
+            step_time_zeropp_on_ms=round(times["on"] * 1e3, 3),
+            zeropp_step_speedup=round(speedup, 3))
+
     sections = [("bert128", sec_bert128)]
     if on_tpu:
         sections += [("bert512", sec_bert512), ("gpt2", sec_gpt2),
@@ -713,6 +761,15 @@ def main():
     # single-device CPU runs skip it (not a failure — no mesh to build).
     if n_chips_all >= 2 and n_chips_all % 2 == 0:
         sections += [("comm_overlap", sec_comm_overlap)]
+    # The zeropp A/B additionally needs a data axis > 1 AND a
+    # power-of-two chip count: on exactly 2 devices build_mesh(slices=2)
+    # gives dcn=2 x data=1 (the hpZ gather axis is size 1), and an odd
+    # data axis (6 devices -> data=3) divides none of tiny-GPT's
+    # power-of-two dims — either way ParamGatherPlan gathers nothing and
+    # the "on" row would baseline a noise-only no-op as a qwZ
+    # measurement.
+    if n_chips_all >= 4 and (n_chips_all & (n_chips_all - 1)) == 0:
+        sections += [("zeropp", sec_zeropp)]
     n_ok = 0
     for name, fn in sections:
         n_ok += bool(run_section(name, fn, result))

@@ -88,7 +88,8 @@ from deepspeed_tpu.comm.quantize import (dequantize_blockwise,
                                          modeled_wire_bytes,
                                          quantize_blockwise, rel_from_parts,
                                          roundtrip_error_parts)
-from deepspeed_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
+from deepspeed_tpu.parallel.mesh import (DATA_AXIS, DCN_AXIS,
+                                         axes_size as mesh_axes_size)
 from deepspeed_tpu.utils.jax_compat import shard_map
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -981,6 +982,358 @@ class GradSyncPlan:
                                   overlap=int(self.overlap))
 
 
+# ---------------------------------------------------------------------------
+# ZeRO++ weight path: the explicit quantized param all-gather (qwZ/hpZ)
+# ---------------------------------------------------------------------------
+
+# The param-hop comm gauges (emitted by ParamGatherPlan.emit_telemetry),
+# pinned against docs/OBSERVABILITY.md in BOTH directions by
+# tests/test_doc_lint.py so fleet/devicetime attribution can always tell
+# parameter traffic from gradient traffic.
+COMM_PARAM_METRIC_TAGS = frozenset({
+    "comm/bytes_dcn_params",
+    "comm/bytes_ici_params",
+})
+
+
+class ParamGatherPlan:
+    """The ZeRO++ weight-path wire protocol (arXiv 2306.10209 qwZ/hpZ):
+    one explicit blockwise-quantized all-gather replacing the implicit
+    full-precision pjit param all-gather for ZeRO stage >= 2.
+
+    Placement comes from the partitioner (runtime/zero/partition.py):
+    with ``zeropp.hpz: off`` the primary param/optimizer partition spans
+    the full (dcn, data) product and this gather crosses DCN with int8
+    codes; with ``hpz: on`` the partition stays intra-slice (the
+    hierarchical secondary partition) and the gather rides ICI only —
+    zero dcn-axis param collectives, asserted by tests/test_zeropp.py.
+
+    Wire protocol per gathered leaf, inside ONE ``shard_map`` manual over
+    the gather axes (everything else — TP specs, the dcn axis under hpZ —
+    stays GSPMD-auto):
+
+    - **int8**: flatten the local fp32 master shard, pad to a block
+      multiple (padding joins a concat — ``jnp.pad`` trips the old
+      partitioner's manual-subgroup check, see ``microstep_buckets``),
+      quantize with the ONE deterministic RTNE core
+      (:func:`deepspeed_tpu.comm.quantize.quantize_blockwise`),
+      all-gather the int8 codes + fp32 scales, dequantize and stitch the
+      full leaf back together. ~4x fewer wire bytes than fp32.
+    - **bf16**: cast the shard, gather, upcast — 2x.
+    - **fp32 passthrough** (``quantized_weights: off`` with hpZ on): a
+      tiled fp32 all-gather — *exact*: the gathered tree is elementwise
+      equal to the replicated master, so the hpZ-only tier is an
+      ulp-parity rung, not a lossy one.
+
+    Leaves below the stage-3 persistence threshold stay replicated
+    (never gathered, no wire traffic); leaves sharded over non-data
+    axes (TP/pipe) keep the implicit path (XLA gathers them in full
+    precision as before — counted as ``fallback_elems``).
+
+    ``measure_quant_error`` (numerics observatory on + a lossy tier):
+    the region additionally returns the RTNE round-trip error of the
+    wire payload vs the fp32 master — one ``[2]`` (rel-L2, max-abs)
+    array psum'd/pmax'd over the manual axes — which the engine routes
+    into the step aux and :class:`~deepspeed_tpu.telemetry.numerics.
+    NumericsObservatory` emits as ``numerics/param_quant_rel_err`` /
+    ``numerics/param_quant_max_abs_err``. Off, the region body is
+    byte-for-byte the measurement-free one.
+
+    The fused step builders hoist the gather out of the GAS scan —
+    parameters are loop-invariant until the apply — so the modeled
+    bytes below are per optimizer step.
+    """
+
+    def __init__(self, zeropp_cfg, mesh: Mesh, param_template: Any,
+                 param_specs: Any, measure_quant_error: bool = False):
+        self.mesh = mesh
+        self.bits = int(zeropp_cfg.wire_bits)
+        self.block = int(zeropp_cfg.quant_block_size)
+        self.hpz = zeropp_cfg.hpz == "on"
+        self.dcn_size = int(mesh.shape.get(DCN_AXIS, 1))
+        self.data_size = int(mesh.shape.get(DATA_AXIS, 1))
+        self.measure_quant = (bool(measure_quant_error)
+                              and self.bits in (8, 16))
+
+        leaves, self.treedef = jax.tree_util.tree_flatten(param_template)
+        spec_leaves = self.treedef.flatten_up_to(param_specs)
+        self.num_leaves = len(leaves)
+        # (leaf idx, sharded dim, axes tuple) per explicitly-gathered leaf.
+        self.gathered: List[Tuple[int, int, Tuple[str, ...]]] = []
+        self.persistent_idx: List[int] = []   # replicated, no wire traffic
+        self.fallback_idx: List[int] = []     # non-data sharding: implicit
+        self._leaf_shapes = [tuple(getattr(l, "shape", ())) for l in leaves]
+        # (leaf idx, ALL sharded axes) per fallback leaf — the hpZ
+        # secondary charge still counts them (fallback_leaves()).
+        self.fallback_axes: List[Tuple[int, Tuple[str, ...]]] = []
+        for i, (leaf, spec) in enumerate(zip(leaves, spec_leaves)):
+            entries = tuple(spec) if spec is not None else ()
+            float_leaf = jnp.issubdtype(leaf.dtype, jnp.floating)
+            dim = None
+            dim_axes: Tuple[str, ...] = ()
+            all_axes: List[str] = []
+            other = False
+            for j, e in enumerate(entries):
+                parts = e if isinstance(e, tuple) else ((e,) if e else ())
+                parts = tuple(a for a in parts if a is not None)
+                if not parts:
+                    continue
+                real = tuple(a for a in parts
+                             if self.mesh.shape.get(a, 1) > 1)
+                if not real:
+                    continue
+                all_axes.extend(real)
+                if set(real) <= {DCN_AXIS, DATA_AXIS}:
+                    dim, dim_axes = j, real
+                else:
+                    other = True
+            if dim is None and not other:
+                self.persistent_idx.append(i)   # truly replicated
+            elif other or not float_leaf:
+                # TP/mixed-axis leaves (the flat-block protocol cannot
+                # stitch a second sharded dim back) and sharded
+                # non-float leaves: implicit full-precision path — they
+                # DO produce wire traffic, so they must count as
+                # fallback, never as persistent.
+                self.fallback_idx.append(i)
+                self.fallback_axes.append((i, tuple(all_axes)))
+            else:
+                self.gathered.append((i, dim, dim_axes))
+        # The region is manual over the data-like axes {dcn, data} even
+        # when the gather itself only names `data` (hpZ): this jax's old
+        # SPMD partitioner rejects a manual subgroup whose AUTO axes sit
+        # OUTSIDE the manual ones in mesh order (manual={data} with dcn
+        # auto is the fatal IsManualSubgroup check; manual={dcn, data} is
+        # the dcn_sync shape that works). Under hpZ every dcn rank holds
+        # the full data-shard (params are dcn-replicated), so the body
+        # computes identical values per slice and emits ZERO dcn-axis
+        # collectives — the property tests/test_zeropp.py asserts.
+        self.manual_axes = sorted(
+            {a for _, _, axes in self.gathered for a in axes}
+            | ({DCN_AXIS} if self.dcn_size > 1 and self.gathered else set()))
+        self.gathered_elems = sum(
+            int(math.prod(self._leaf_shapes[i])) for i, _, _ in self.gathered)
+        self.fallback_elems = sum(
+            int(math.prod(self._leaf_shapes[i])) for i in self.fallback_idx)
+        self.persistent_elems = sum(
+            int(math.prod(self._leaf_shapes[i]) or 1)
+            for i in self.persistent_idx)
+        self._gather_fn = None
+
+    # ------------------------------------------------------------------
+    def _restricted_spec(self, i: int, dim: int,
+                         axes: Tuple[str, ...]) -> P:
+        """shard_map in_spec for one gathered leaf: only the manual
+        (gather) axes; everything else stays GSPMD-auto."""
+        ndim = len(self._leaf_shapes[i])
+        entries: List[Any] = [None] * ndim
+        entries[dim] = axes if len(axes) > 1 else axes[0]
+        return P(*entries)
+
+    def gather(self, params: Any):
+        """The explicit gather, traced inside the jitted step: returns
+        ``(full_params fp32 tree, qerr)`` where the gathered leaves are
+        fully replicated over the gather axes (the engine's precision
+        policy casts to the compute dtype afterwards — elementwise, so
+        the fp32 passthrough stays exact) and ``qerr`` is the ``[2]``
+        (rel-L2, max-abs) wire round-trip error (None unless
+        ``measure_quant_error``)."""
+        leaves = self.treedef.flatten_up_to(params)
+        if not self.gathered:
+            return params, None
+        if self._gather_fn is None:
+            self._gather_fn = self._build_gather_fn()
+        out = self._gather_fn(tuple(leaves[i] for i, _, _ in self.gathered))
+        if self.measure_quant:
+            full, qerr = out
+        else:
+            full, qerr = out, None
+        merged = list(leaves)
+        for (i, _, _), f in zip(self.gathered, full):
+            merged[i] = f
+        return jax.tree_util.tree_unflatten(self.treedef, merged), qerr
+
+    def _build_gather_fn(self):
+        measure = self.measure_quant
+        bits, block = self.bits, self.block
+        mesh = self.mesh
+        red_axes = tuple(self.manual_axes)
+
+        def gather_leaf(x, dim, axes):
+            name = axes if len(axes) > 1 else axes[0]
+            n = mesh_axes_size(mesh.shape, axes)
+            if bits == 32:
+                # Exact passthrough: one tiled fp32 all-gather stitches
+                # the full leaf along the sharded dim directly.
+                return jax.lax.all_gather(x, name, axis=dim,
+                                          tiled=True), None
+            flat = x.reshape(-1).astype(jnp.float32)
+            m = flat.shape[0]
+            pad = (-m) % block
+            if pad:
+                # Padding joins the concat instead of jnp.pad (the old
+                # partitioner's manual-subgroup check — see
+                # microstep_buckets).
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad,), jnp.float32)])
+            err = (roundtrip_error_parts(flat, bits, block)
+                   if measure else None)
+            if bits == 8:
+                q, s = quantize_blockwise(flat, block)
+                qg = jax.lax.all_gather(q, name, axis=0, tiled=False)
+                sg = jax.lax.all_gather(s, name, axis=0, tiled=False)
+                deq = dequantize_blockwise(qg, sg, block)
+            else:       # bf16 wire
+                wg = jax.lax.all_gather(flat.astype(jnp.bfloat16), name,
+                                        axis=0, tiled=False)
+                deq = wg.astype(jnp.float32)
+            shards = deq[:, :m].reshape((n,) + x.shape)
+            full = jnp.moveaxis(shards, 0, dim).reshape(
+                x.shape[:dim] + (n * x.shape[dim],) + x.shape[dim + 1:])
+            return full, err
+
+        red_size = mesh_axes_size(mesh.shape, red_axes)
+
+        def body(ls):
+            outs = []
+            err_sq = ref_sq = mab = jnp.float32(0.0)
+            for (idx, dim, axes), x in zip(self.gathered, ls):
+                full, err = gather_leaf(x, dim, axes)
+                outs.append(full)
+                if err is not None:
+                    e, r, ma = err
+                    # The psum below runs over ALL manual axes, but a
+                    # leaf gathered over a subset (e.g. a (data,)-only
+                    # fallback leaf under the hpz=off global primary, or
+                    # every leaf under hpZ where the region is manual
+                    # over dcn too) holds REPLICATED shards along the
+                    # rest — pre-divide by the replication factor so
+                    # each unique shard's error counts exactly once and
+                    # mixed trees aren't skewed toward replicated leaves.
+                    gather_size = mesh_axes_size(mesh.shape, axes)
+                    w = jnp.float32(gather_size / red_size)
+                    err_sq = err_sq + e * w
+                    ref_sq = ref_sq + r * w
+                    mab = jnp.maximum(mab, ma)
+            if not measure:
+                return tuple(outs)
+            rel = rel_from_parts(jax.lax.psum(err_sq, red_axes),
+                                 jax.lax.psum(ref_sq, red_axes))
+            return tuple(outs), jnp.stack(
+                [rel, jax.lax.pmax(mab, red_axes)])
+
+        in_specs = (tuple(self._restricted_spec(i, dim, axes)
+                          for i, dim, axes in self.gathered),)
+        out_leaf_specs = tuple(
+            P(*([None] * len(self._leaf_shapes[i])))
+            for i, _, _ in self.gathered)
+        out_specs = ((out_leaf_specs, P()) if measure else out_leaf_specs)
+        return shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs,
+                         axis_names=set(self.manual_axes),
+                         check_vma=False)
+
+    # ------------------------------------------------------------------
+    # modeling / telemetry
+    # ------------------------------------------------------------------
+    def gathered_leaves(self, tree: Any = None) -> List[Tuple[Tuple[int, ...], Tuple[str, ...], Any]]:
+        """(global shape, gather axes, companion-tree leaf) per
+        explicitly-gathered leaf — what the memory ledger sizes the
+        gathered compute-tree footprint from (persistent leaves stay
+        replicated; fallback leaves ride the implicit path, so neither
+        is gathered in full here). ``tree`` is an optional companion
+        pytree of the params structure (the engine's base partition
+        specs); None yields None companions."""
+        comp = ([None] * self.num_leaves if tree is None
+                else self.treedef.flatten_up_to(tree))
+        return [(self._leaf_shapes[i], axes, comp[i])
+                for i, _, axes in self.gathered]
+
+    def fallback_leaves(self, tree: Any = None) -> List[Tuple[Tuple[int, ...], Tuple[str, ...], Any]]:
+        """Same triples for the implicit-path (TP/mixed-axis) leaves,
+        with ALL their sharded mesh axes. They skip the explicit gather
+        but still carry the partitioner's primary placement on their
+        free dim — so the hpZ secondary charge must count them alongside
+        the gathered leaves (a global (hpz off) primary would spread
+        them over dcn too)."""
+        comp = ([None] * self.num_leaves if tree is None
+                else self.treedef.flatten_up_to(tree))
+        return [(self._leaf_shapes[i], axes, comp[i])
+                for i, axes in self.fallback_axes]
+
+    def modeled_bytes(self) -> dict:
+        """Per-device per-optimizer-step modeled wire bytes of the param
+        gather, split by link direction (self-shard included — an upper
+        bound; ratios between tiers are exact, the GradSyncPlan
+        convention). ``bytes_params_fp32`` is the same gather at fp32
+        wire — the compression denominator. Persistent (replicated)
+        leaves never hit the wire; fallback (TP-sharded) leaves ride the
+        implicit full-precision path and are excluded from the explicit
+        totals (reported so the probe can see them)."""
+        bytes_dcn = bytes_ici = fp32 = 0.0
+        for i, _, axes in self.gathered:
+            elems = int(math.prod(self._leaf_shapes[i]))
+            wire = modeled_wire_bytes(elems, self.bits, self.block)
+            ref = modeled_wire_bytes(elems, 32, self.block)
+            dcn_frac = ((self.dcn_size - 1) / self.dcn_size
+                        if DCN_AXIS in axes and self.dcn_size > 1 else 0.0)
+            bytes_dcn += wire * dcn_frac
+            bytes_ici += wire * (1.0 - dcn_frac)
+            fp32 += ref
+        wire_total = bytes_dcn + bytes_ici
+        return {
+            "bytes_dcn_params": int(bytes_dcn),
+            "bytes_ici_params": int(bytes_ici),
+            "bytes_params_fp32": int(fp32),
+            "compression_ratio": (fp32 / wire_total if wire_total else 1.0),
+            "gathered_elems": self.gathered_elems,
+            "fallback_elems": self.fallback_elems,
+            "persistent_elems": self.persistent_elems,
+            "hpz": int(self.hpz),
+            "bits": self.bits,
+        }
+
+    def modeled_wire_seconds(self, dcn_gbps: float,
+                             ici_gbps: float) -> float:
+        """Modeled collective seconds per optimizer step of the explicit
+        param gather at the nominal link bandwidths (the engine passes
+        the grad plan's comm.dcn_gbps/ici_gbps). The gather runs
+        sequentially before the fused fwd/bwd — nothing is scheduled to
+        hide it — so callers count ALL of it as exposed
+        (``_emit_comm_attribution``: the modeled ``comm/exposed_frac``
+        must include this hop or the PR-9 modeled-vs-measured divergence
+        warning fires spuriously whenever zeropp rides with the
+        hierarchical sync)."""
+        m = self.modeled_bytes()
+        return (m["bytes_dcn_params"] / (dcn_gbps * 1e9)
+                + m["bytes_ici_params"] / (ici_gbps * 1e9))
+
+    def describe(self) -> str:
+        m = self.modeled_bytes()
+        tier = {8: "int8", 16: "bf16", 32: "fp32"}[self.bits]
+        return (f"zeropp: param gather {tier} block={self.block} "
+                f"hpz={'on' if self.hpz else 'off'} "
+                f"axes={self.manual_axes} leaves={len(self.gathered)} "
+                f"({self.gathered_elems} elems; {self.persistent_elems} "
+                f"persistent, {self.fallback_elems} fallback) modeled "
+                f"dcn/ici bytes {m['bytes_dcn_params']}/"
+                f"{m['bytes_ici_params']} "
+                f"({m['compression_ratio']:.2f}x vs fp32)")
+
+    def emit_telemetry(self, telemetry, step: int) -> None:
+        """The param-hop direction of the comm byte attribution
+        (comm/bytes_dcn_params, comm/bytes_ici_params) — modeled from
+        the plan shape like the grad gauges, no device sync."""
+        if telemetry is None or not getattr(telemetry, "enabled", False):
+            return
+        m = self.modeled_bytes()
+        reg = telemetry.registry
+        reg.gauge("comm/bytes_dcn_params").set(m["bytes_dcn_params"],
+                                               step=step)
+        reg.gauge("comm/bytes_ici_params").set(m["bytes_ici_params"],
+                                               step=step)
+
+
 # The ISSUE-facing name: the plan IS the strategy object the engines wire
 # in (one per engine, bound to its grad tree at step-construction time).
 GradSyncStrategy = GradSyncPlan
@@ -1006,9 +1359,7 @@ def dcn_batch_leaf_specs(batches: Any, batch_spec, mesh: Mesh,
         entries = base[:x.ndim]
         for d, e in zip(x.shape, entries):
             parts = e if isinstance(e, tuple) else ((e,) if e else ())
-            n = 1
-            for a in parts:
-                n *= mesh.shape.get(a, 1)
+            n = mesh_axes_size(mesh.shape, parts)
             if n > 1 and d % n:
                 return P(*([None] * x.ndim))
         return P(*entries)

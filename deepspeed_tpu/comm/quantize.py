@@ -8,10 +8,12 @@ collectives cut that traffic ~4x with negligible quality loss, and EQuARX
 module is the numeric half of that design: deterministic int8 round-trips
 with per-block fp32 scales. It is the tree's ONE int8 implementation —
 consumers: :mod:`deepspeed_tpu.comm.grad_sync` (DCN stage of the
-hierarchical gradient sync), :mod:`deepspeed_tpu.inference.quantization`
-(int8 weights, one block per (group, output-channel)), and
-:mod:`deepspeed_tpu.serving.kv_cache` (int8 KV pools, one block per
-(token, head) vector).
+hierarchical gradient sync AND the ZeRO++ qwZ param all-gather,
+``ParamGatherPlan`` — the lossy *parameter* hop the numerics
+observatory's ``numerics/param_quant_rel_err`` measures),
+:mod:`deepspeed_tpu.inference.quantization` (int8 weights, one block per
+(group, output-channel)), and :mod:`deepspeed_tpu.serving.kv_cache`
+(int8 KV pools, one block per (token, head) vector).
 
 Properties the grad-sync protocol relies on (tested in tests/test_dcn.py):
 
@@ -130,7 +132,10 @@ def roundtrip_error(x: jax.Array, bits: int = 8,
 def modeled_wire_bytes(num_elems: int, bits: int, block_size: int) -> int:
     """Bytes one direction of a quantized transfer of ``num_elems`` puts
     on the wire: payload codes + per-block fp32 scales. For the bf16/fp32
-    passthrough tiers (bits 16/32) there are no scales."""
+    passthrough tiers (bits 16/32) there are no scales. Callers split the
+    result by *direction* — grad traffic (``comm/bytes_dcn``/``_ici``)
+    vs param traffic (``comm/bytes_dcn_params``/``_ici_params``) — so
+    fleet/devicetime attribution can tell the two hops apart."""
     if bits == 8:
         return num_elems + 4 * (num_elems // block_size)
     return num_elems * (bits // 8)

@@ -1166,6 +1166,26 @@ class DeepSpeedTPUConfig:
                               "use stage 1 (reference pipe/engine.py:56)")
         if self.fp16.enabled and self.amp_enabled:
             raise ConfigError("fp16 and amp cannot both be enabled")
+        if self.zero_config.zeropp.active:
+            # Validated HERE (not only in the engine) so the user-level
+            # initialize(model=..., offload_param=...) path fails with
+            # the real cause instead of crashing in the offload-tier
+            # model conversion it runs before engine construction.
+            if self.zero_config.offload_param.enabled:
+                raise ConfigError(
+                    "zero_optimization.zeropp cannot compose with "
+                    "offload_param: the hpZ secondary replica lives in "
+                    "HBM while the offloaded primary partition lives in "
+                    "host memory — the explicit quantized param gather "
+                    "is a mesh collective, not a host fetch; drop "
+                    "offload_param or disable zeropp")
+            if self.zero_config.offload_optimizer.enabled:
+                raise ConfigError(
+                    "zero_optimization.zeropp cannot compose with "
+                    "offload_optimizer: the offload tier's params reach "
+                    "the device by host transfer, not a mesh all-gather "
+                    "— there is no wire hop for qwZ to quantize; use a "
+                    "device-resident optimizer tier")
         if (self.telemetry.memory.enabled and self.guardrails.watchdog.enabled
                 and self.telemetry.memory.oom_exit_code
                 == self.guardrails.watchdog.exit_code):

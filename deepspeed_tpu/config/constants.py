@@ -424,3 +424,8 @@ COMM_ICI_GBPS = "ici_gbps"
 COMM_ICI_GBPS_DEFAULT = 90.0
 COMM_DCN_GBPS = "dcn_gbps"
 COMM_DCN_GBPS_DEFAULT = 12.5
+
+# ZeRO++ weight path: zero_optimization.zeropp — runtime/zero/config.py
+# ZeroPPConfig owns the keys/defaults (they live beside the other
+# zero_optimization key constants); the param-hop comm gauge names are
+# declared in comm/grad_sync.py COMM_PARAM_METRIC_TAGS, doc-lint-pinned.

@@ -202,6 +202,18 @@ def data_sharding(mesh: Mesh, batch_axes: Sequence[str] = (DATA_AXIS,)) -> Named
     return NamedSharding(mesh, PartitionSpec(tuple(batch_axes)))
 
 
+def axes_size(mesh_shape, axes) -> int:
+    """Product of the named axes' sizes in a mesh-shape mapping (absent
+    axes count 1). The ONE definition of how an axes tuple maps to a
+    shard count — the ZeRO partitioner, ParamGatherPlan's wire model /
+    qerr weighting, and the memory ledger must all agree on it (accepts
+    both ``mesh.shape`` and plain dicts)."""
+    n = 1
+    for a in axes:
+        n *= int(mesh_shape.get(a, 1))
+    return n
+
+
 def data_like_axes(mesh: Mesh) -> tuple:
     """The mesh's data-parallel axes with size > 1 (dcn-outer + ici
     data), falling back to ``(data,)`` on a trivial mesh — the ONE

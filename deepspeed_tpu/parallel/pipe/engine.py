@@ -36,6 +36,12 @@ class PipelineEngine(TPUEngine):
     reference's ``micro_batches`` role: train_batch consumes GAS microbatches
     and pipelines them."""
 
+    # This engine compiles its own step path — the ZeRO++ weight gather
+    # (zero_optimization.zeropp) is unreachable here (and its stage >= 2
+    # requirement collides with this engine's stage <= 1 rule anyway);
+    # the base validation fails loudly instead of silently ignoring it.
+    _supports_zeropp = False
+
     def __init__(self, pipe_model: PipeModel, config: DeepSpeedTPUConfig,
                  mesh: Optional[Mesh] = None, **kwargs):
         if config.zero_config.stage >= 2:

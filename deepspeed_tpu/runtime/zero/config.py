@@ -62,6 +62,19 @@ STAGE3_PARAM_PERSISTENCE_THRESHOLD = "stage3_param_persistence_threshold"
 STAGE3_PARAM_PERSISTENCE_THRESHOLD_DEFAULT = 1e5
 STAGE3_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE = "stage3_gather_fp16_weights_on_model_save"
 
+# ZeRO++ (arXiv 2306.10209) weight-path block — see ZeroPPConfig.
+ZEROPP = "zeropp"
+ZEROPP_QUANTIZED_WEIGHTS = "quantized_weights"
+ZEROPP_QUANTIZED_WEIGHTS_DEFAULT = "off"      # off | bf16 | int8
+ZEROPP_QUANT_BLOCK_SIZE = "quant_block_size"
+ZEROPP_QUANT_BLOCK_SIZE_DEFAULT = 256
+ZEROPP_HPZ = "hpz"
+ZEROPP_HPZ_DEFAULT = "off"                    # off | on
+
+# Wire bits of each quantized_weights tier (the comm/quantize.py core's
+# bits argument — 32 is the exact fp32 passthrough hpZ alone uses).
+ZEROPP_WIRE_BITS = {"off": 32, "bf16": 16, "int8": 8}
+
 
 @dataclass
 class ZeroOffloadConfig:
@@ -93,6 +106,82 @@ class ZeroOffloadConfig:
 
 
 @dataclass
+class ZeroPPConfig:
+    """``zero_optimization.zeropp`` — the ZeRO++ weight path
+    (arXiv 2306.10209 qwZ/hpZ + weight-update sharding arXiv 2004.13336;
+    runtime/zero/partition.py for the placement half,
+    comm/grad_sync.py ``ParamGatherPlan`` for the wire protocol).
+
+    ``quantized_weights``: the wire dtype of the explicit fwd/bwd param
+    all-gather — ``int8`` (blockwise RTNE codes + per-block fp32 scales,
+    the one int8 core in comm/quantize.py), ``bf16``, or ``off`` (fp32
+    passthrough when the block is otherwise active; with ``hpz`` off too
+    the whole block is inert and the lowered step is bit-identical to a
+    zeropp-less config).
+    ``quant_block_size``: elements per quantization block.
+    ``hpz``: ``on`` keeps the param partition *intra-slice* (the
+    hierarchical secondary partition — fwd/bwd gathers ride ICI only and
+    cross-slice param traffic is zero; the dcn-replica HBM cost is
+    charged to the memory ledger); ``off`` (with the block active) spans
+    the primary partition over the full (dcn x data) world — maximal
+    HBM savings, param gathers cross DCN (quantized).
+    """
+
+    quantized_weights: str = ZEROPP_QUANTIZED_WEIGHTS_DEFAULT
+    quant_block_size: int = ZEROPP_QUANT_BLOCK_SIZE_DEFAULT
+    hpz: str = ZEROPP_HPZ_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ZeroPPConfig":
+        if d is None:
+            return cls()
+        if not isinstance(d, dict):
+            raise ValueError(f"{ZEROPP} must be a dict, got {type(d)}")
+        d = dict(d)
+        cfg = cls(
+            quantized_weights=str(d.pop(
+                ZEROPP_QUANTIZED_WEIGHTS,
+                ZEROPP_QUANTIZED_WEIGHTS_DEFAULT)).lower(),
+            quant_block_size=int(d.pop(ZEROPP_QUANT_BLOCK_SIZE,
+                                       ZEROPP_QUANT_BLOCK_SIZE_DEFAULT)),
+            hpz=str(d.pop(ZEROPP_HPZ, ZEROPP_HPZ_DEFAULT)).lower(),
+        )
+        if d:
+            raise ValueError(f"unknown {ZEROPP} keys: {sorted(d)}")
+        if cfg.quantized_weights not in ZEROPP_WIRE_BITS:
+            raise ValueError(
+                f"{ZEROPP}.{ZEROPP_QUANTIZED_WEIGHTS} must be one of "
+                f"{sorted(ZEROPP_WIRE_BITS)}, got "
+                f"'{cfg.quantized_weights}'")
+        if cfg.quant_block_size <= 0:
+            raise ValueError(
+                f"{ZEROPP}.{ZEROPP_QUANT_BLOCK_SIZE} must be positive, "
+                f"got {cfg.quant_block_size}")
+        if cfg.hpz not in ("off", "on"):
+            raise ValueError(
+                f"{ZEROPP}.{ZEROPP_HPZ} must be off|on, got '{cfg.hpz}'")
+        return cfg
+
+    @property
+    def active(self) -> bool:
+        """Whether the block changes the step at all: any lossy wire tier
+        OR the hpZ partition. Inactive (the default) must leave the
+        lowered step bit-identical — the PR 4 off-identity contract."""
+        return self.quantized_weights != "off" or self.hpz == "on"
+
+    @property
+    def wire_bits(self) -> int:
+        return ZEROPP_WIRE_BITS[self.quantized_weights]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            ZEROPP_QUANTIZED_WEIGHTS: self.quantized_weights,
+            ZEROPP_QUANT_BLOCK_SIZE: self.quant_block_size,
+            ZEROPP_HPZ: self.hpz,
+        }
+
+
+@dataclass
 class ZeroConfig:
     stage: int = ZERO_STAGE_DEFAULT
     allgather_partitions: bool = ALLGATHER_PARTITIONS_DEFAULT
@@ -111,6 +200,7 @@ class ZeroConfig:
     param_persistence_threshold: float = STAGE3_PARAM_PERSISTENCE_THRESHOLD_DEFAULT
     gather_fp16_weights_on_model_save: bool = False
     legacy_stage1: bool = False
+    zeropp: ZeroPPConfig = field(default_factory=ZeroPPConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ZeroConfig":
@@ -141,6 +231,7 @@ class ZeroConfig:
         cfg.legacy_stage1 = bool(d.pop(LEGACY_STAGE1, cfg.legacy_stage1))
         cfg.offload_param = ZeroOffloadConfig.from_dict(d.pop(OFFLOAD_PARAM, None))
         cfg.offload_optimizer = ZeroOffloadConfig.from_dict(d.pop(OFFLOAD_OPTIMIZER, None))
+        cfg.zeropp = ZeroPPConfig.from_dict(d.pop(ZEROPP, None))
         # Legacy stage-2 flag: cpu_offload=true ≡ offload_optimizer.device=cpu.
         if d.pop(CPU_OFFLOAD, False):
             cfg.offload_optimizer = ZeroOffloadConfig(device=OFFLOAD_DEVICE_CPU)
@@ -166,4 +257,5 @@ class ZeroConfig:
             SUB_GROUP_SIZE: self.sub_group_size,
             OFFLOAD_OPTIMIZER: {"device": self.offload_optimizer.device},
             OFFLOAD_PARAM: {"device": self.offload_param.device},
+            ZEROPP: self.zeropp.to_dict(),
         }

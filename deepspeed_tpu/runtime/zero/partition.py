@@ -35,7 +35,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from deepspeed_tpu.parallel.mesh import DATA_AXIS
+from deepspeed_tpu.parallel.mesh import (DATA_AXIS, DCN_AXIS,
+                                         axes_size as mesh_axes_size)
 from deepspeed_tpu.runtime.zero.config import ZeroConfig
 
 
@@ -91,50 +92,148 @@ class ZeroPartitioner:
         self.config = config
         self.policy = ZeroPolicy.for_stage(config.stage)
         self.data_size = mesh.shape.get(DATA_AXIS, 1)
+        self.dcn_size = mesh.shape.get(DCN_AXIS, 1)
         self.persistence_threshold = int(
             persistence_threshold if persistence_threshold is not None
             else config.param_persistence_threshold)
+        # ZeRO++ weight path (zeropp block; arXiv 2306.10209 / 2004.13336):
+        # with the block active and stage >= 2, params ALWAYS carry the
+        # explicit partition (the implicit stage-2 post-apply all-gather
+        # becomes the explicit quantized fwd gather — comm/grad_sync.py
+        # ParamGatherPlan). hpz=off spans the PRIMARY partition over the
+        # full (dcn, data) product — maximal master/optimizer HBM savings,
+        # param gathers cross DCN (quantized); hpz=on keeps the partition
+        # intra-slice (the hierarchical SECONDARY partition): gathers ride
+        # ICI only, the dcn replica's HBM cost is charged to the memory
+        # ledger. Inactive (the default) all axes stay (data,) and every
+        # spec below is byte-identical to the pre-zeropp partitioner.
+        zpp = config.zeropp
+        self._zeropp_shard_params = bool(zpp.active and config.stage >= 2)
+        if zpp.active and zpp.hpz == "off" and self.dcn_size > 1:
+            self.primary_axes: Tuple[str, ...] = (DCN_AXIS, DATA_AXIS)
+        else:
+            self.primary_axes = (DATA_AXIS,)
 
     # -- spec computation ---------------------------------------------------
-    def _data_shard_spec(self, shape: Tuple[int, ...],
-                         base_spec: Optional[PartitionSpec],
-                         min_size: int = 1) -> PartitionSpec:
-        """Add a data-axis sharding to base_spec on the best free dimension."""
+    def _axes_size(self, axes: Tuple[str, ...]) -> int:
+        return mesh_axes_size(self.mesh.shape, axes)
+
+    def _shard_spec(self, shape: Tuple[int, ...],
+                    base_spec: Optional[PartitionSpec],
+                    axes: Tuple[str, ...],
+                    min_size: int = 1) -> PartitionSpec:
+        """Add an ``axes`` sharding to base_spec on the best free
+        dimension (the generalized ``_data_shard_spec`` — (data,) for the
+        classic ZeRO partition, (dcn, data) for the zeropp global primary
+        partition)."""
         base = tuple(base_spec) if base_spec is not None else ()
         base = base + (None,) * (len(shape) - len(base))
-        # A base spec may already place the data axis (e.g. TiledLinear's
-        # stage-3 kernel spec) — adding it again would duplicate the axis.
+        # A base spec may already place one of the target axes (e.g.
+        # TiledLinear's stage-3 kernel spec places data) — adding it again
+        # would duplicate the axis.
         for s in base:
             parts = s if isinstance(s, tuple) else (s,)
-            if DATA_AXIS in parts:
+            if any(a in parts for a in axes):
                 return PartitionSpec(*base)
+        axes_size = self._axes_size(axes)
         # Dimensions already taken by model/sequence axes are not available.
         free_dims = [i for i, s in enumerate(base) if s is None]
         candidates = []
         for i in free_dims:
             d = shape[i]
-            # the dim must divide by data axis AFTER any existing sharding on
-            # other dims (existing specs shard other dims, so d is intact)
-            if d % self.data_size == 0:
+            # the dim must divide by the axes product AFTER any existing
+            # sharding on other dims (existing specs shard other dims, so
+            # d is intact)
+            if d % axes_size == 0:
                 candidates.append((d, i))
         if not candidates or int(np.prod(shape)) < min_size:
             return PartitionSpec(*base) if any(s is not None for s in base) else PartitionSpec()
         _, dim = max(candidates)
         new = list(base)
-        new[dim] = DATA_AXIS
+        new[dim] = axes if len(axes) > 1 else axes[0]
         return PartitionSpec(*new)
+
+    def _data_shard_spec(self, shape: Tuple[int, ...],
+                         base_spec: Optional[PartitionSpec],
+                         min_size: int = 1) -> PartitionSpec:
+        """Add a data-axis sharding to base_spec on the best free dimension."""
+        return self._shard_spec(shape, base_spec, (DATA_AXIS,),
+                                min_size=min_size)
+
+    @staticmethod
+    def _places(spec: PartitionSpec, axes: Tuple[str, ...]) -> bool:
+        for s in tuple(spec):
+            parts = s if isinstance(s, tuple) else (s,)
+            if any(a in parts for a in axes):
+                return True
+        return False
+
+    def _primary_spec(self, shape: Tuple[int, ...],
+                      base_spec: Optional[PartitionSpec],
+                      min_size: int = 1) -> PartitionSpec:
+        """Primary-partition spec. Under the zeropp global primary a
+        leaf whose free dims divide ``data`` but not ``dcn * data``
+        (e.g. dim 12 on a dcn2 x data4 mesh) must fall back to the
+        intra-slice (data,) partition, NOT to full replication — plain
+        stage 3 sharded such leaves over data and the "maximal HBM
+        savings" mode can never do worse; the leaf then behaves like an
+        hpZ leaf (data-sharded, dcn-replicated, ICI-only gather)."""
+        spec = self._shard_spec(shape, base_spec, self.primary_axes,
+                                min_size=min_size)
+        if len(self.primary_axes) > 1 \
+                and not self._places(spec, self.primary_axes):
+            return self._shard_spec(shape, base_spec, (DATA_AXIS,),
+                                    min_size=min_size)
+        return spec
 
     def param_spec(self, shape: Tuple[int, ...],
                    base_spec: Optional[PartitionSpec] = None) -> PartitionSpec:
-        if self.policy.shard_params:
+        if self.policy.shard_params or self._zeropp_shard_params:
             # Small params stay resident/replicated — the stage-3
             # param_persistence_threshold (stage3.py:1406).
-            return self._data_shard_spec(shape, base_spec,
-                                         min_size=self.persistence_threshold)
+            return self._primary_spec(shape, base_spec,
+                                      min_size=self.persistence_threshold)
         return base_spec if base_spec is not None else PartitionSpec()
+
+    def hpz_replica_shard_elems(self, gathered_leaves) -> int:
+        """ZeRO++ hpZ secondary-charge support (telemetry/memory.py):
+        the per-device master-shard ELEMS of the gathered leaves a
+        global (hpz off) primary could actually spread over dcn — the
+        replica bytes flipping hpz off would save. Leaves the global
+        primary cannot shard over dcn (base-pinned data axis, dims not
+        divisible by dcn x data) contribute nothing: they keep the same
+        (data,) partition either way. Lives HERE, beside the placement
+        rules it mirrors, so the counterfactual can never drift from
+        real placement. ``gathered_leaves``: (shape, sharded axes,
+        base_spec) triples from ``ParamGatherPlan.gathered_leaves()`` —
+        plus its ``fallback_leaves()``, whose free dim carries the same
+        primary placement despite riding the implicit gather path."""
+        from dataclasses import replace
+        zpp = replace(self.config.zeropp, hpz="off")
+        if not zpp.active:
+            # fp32-passthrough tier: flipping hpz alone would make the
+            # block inert; placement only depends on active, not on the
+            # wire dtype.
+            zpp = replace(zpp, quantized_weights="bf16")
+        glob = ZeroPartitioner(
+            self.mesh, replace(self.config, zeropp=zpp),
+            persistence_threshold=self.persistence_threshold)
+        total = 0
+        for shape, axes, base in gathered_leaves:
+            if not self._places(glob.param_spec(shape, base),
+                                (DCN_AXIS,)):
+                continue
+            n = self._axes_size(axes)
+            total += int(np.prod(shape)) // max(n, 1)
+        return total
 
     def grad_spec(self, shape: Tuple[int, ...],
                   base_spec: Optional[PartitionSpec] = None) -> PartitionSpec:
+        # Grads stay on the ICI-inner data axis in every configuration —
+        # including zeropp (active only at stage >= 2, where shard_grads
+        # already holds): the grad-sync machinery (implicit,
+        # hierarchical, overlapped) reduces over dcn and scatters over
+        # data, and a dcn-sharded accumulator would break that contract.
         if self.policy.shard_grads or self.policy.shard_params:
             return self._data_shard_spec(shape, base_spec)
         return base_spec if base_spec is not None else PartitionSpec()
@@ -142,7 +241,12 @@ class ZeroPartitioner:
     def opt_state_spec(self, shape: Tuple[int, ...],
                        base_spec: Optional[PartitionSpec] = None) -> PartitionSpec:
         if self.policy.shard_optimizer_state:
-            return self._data_shard_spec(shape, base_spec)
+            # Under the zeropp global primary the moments follow the
+            # (dcn, data) partition — the sharded optimizer apply
+            # (2004.13336) then updates each rank's primary shard only —
+            # with the same data-axis fallback as param_spec so moments
+            # never shard differently from their master leaf.
+            return self._primary_spec(shape, base_spec)
         return base_spec if base_spec is not None else PartitionSpec()
 
     # -- tree-level helpers -------------------------------------------------

@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from deepspeed_tpu.config.constants import MEMORY_OOM_EXIT_CODE_DEFAULT
+from deepspeed_tpu.parallel.mesh import axes_size as mesh_axes_size
 from deepspeed_tpu.telemetry.goodput import _atomic_write_json
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -75,8 +76,14 @@ OOM_COUNTER = "memory/oom_crashdumps"
 _XLA_FIELDS = ("argument", "output", "temp", "alias", "generated_code")
 
 # Ledger components emitted as memory/ledger_<component>_bytes gauges.
+# "secondary" is the ZeRO++ hpZ replica charge: with the intra-slice
+# secondary partition (zero_optimization.zeropp.hpz) the master+moments
+# stay dcn-replicated, and this gauge is the per-device HBM that replica
+# costs vs the (dcn x data) global primary partition — an attribution
+# overlay on bytes already counted in master/optimizer, NOT an extra
+# allocation (so it is excluded from the per-device model-state sum).
 _LEDGER_COMPONENTS = ("master", "optimizer", "grads", "compute_params",
-                      "scalars", "device", "host")
+                      "scalars", "device", "host", "secondary")
 
 # Every metric tag this module can emit (gauges, the OOM counter and the
 # trace-instant names) — pinned against docs/OBSERVABILITY.md in BOTH
@@ -176,9 +183,7 @@ def _leaf_shard_bytes(leaf, spec, mesh_shape: Dict[str, int]) -> int:
     for i, d in enumerate(shape):
         e = entries[i] if i < len(entries) else None
         parts = e if isinstance(e, tuple) else ((e,) if e else ())
-        n = 1
-        for a in parts:
-            n *= int(mesh_shape.get(a, 1))
+        n = mesh_axes_size(mesh_shape, parts)
         elems *= -(-int(d) // max(n, 1))
     return elems * itemsize
 
@@ -305,10 +310,63 @@ def model_state_ledger(engine) -> Dict[str, Any]:
             per_dev["compute_params_bytes"] = _tree_shard_bytes(
                 compute_template, live_param_specs, mesh_shape)
             full["compute_params_bytes"] = total_params * compute_itemsize
+        zpp_plan = getattr(engine, "param_gather_plan", None)
+        if zpp_plan is not None:
+            # ZeRO++: the explicit all-gather materializes each gathered
+            # leaf FULL (replicated over its gather axes) in the compute
+            # dtype, live across the whole fused fwd/bwd (the gather is
+            # hoisted out of the GAS scan). The cast accounting above
+            # booked those leaves at their sharded master layout — and a
+            # pure-fp32 run booked nothing at all, though its gathered
+            # fp32 tree is a real extra full copy.
+            g_full = g_shard = 0
+            for shape, axes, _ in zpp_plan.gathered_leaves():
+                e = int(np.prod(shape))
+                n = mesh_axes_size(mesh_shape, axes)
+                g_full += e
+                g_shard += e // max(n, 1)
+            if engine.precision.mixed:
+                per_dev["compute_params_bytes"] += (
+                    (g_full - g_shard) * compute_itemsize)
+            else:
+                per_dev["compute_params_bytes"] += g_full * 4
+                full["compute_params_bytes"] += g_full * 4
 
     per_dev["model_state_bytes"] = int(sum(per_dev.values()))
     host["total_bytes"] = int(sum(host.values()))
+    # ZeRO++ hpZ secondary-replica charge (runtime/zero/partition.py):
+    # the intra-slice partition keeps master+moments dcn-replicated so
+    # param gathers never cross DCN; the replica's per-device cost vs the
+    # (dcn x data) global primary is (1 - 1/dcn) of the fp32 state. An
+    # attribution overlay (the bytes are already in master/optimizer) —
+    # deliberately NOT added to model_state_bytes above.
+    dcn = int(mesh_shape.get("dcn", 1))
+    plan = getattr(engine, "param_gather_plan", None)
+    hpz = bool(plan is not None and getattr(plan, "hpz", False) and dcn > 1)
+    secondary_bytes = 0
+    if hpz:
+        # Only leaves a global (hpz off) primary could ACTUALLY shard
+        # over dcn are part of the charge — the counterfactual lives
+        # beside the placement rules (ZeroPartitioner
+        # .hpz_replica_shard_elems), asked per leaf WITH its base
+        # partition spec. The charge sums the dcn-shardable leaves'
+        # SHARD bytes directly (persistent leaves sit in master_bytes
+        # at full replicated weight — a blended fraction would
+        # overcharge them), with the moments scaled by the full-tree
+        # optimizer/master ratio (moments mirror params elementwise).
+        # Implicit-path (TP fallback) leaves count too: they skip the
+        # explicit gather but their free dim still carries the primary
+        # placement, so the global primary would spread them over dcn.
+        base = getattr(engine, "_base_specs", None)
+        shard_master_bytes = 4 * engine.partitioner.hpz_replica_shard_elems(
+            plan.gathered_leaves(base) + plan.fallback_leaves(base))
+        opt_ratio = (full["optimizer_bytes"] / full["master_bytes"]
+                     if full["master_bytes"] else 0.0)
+        secondary_bytes = int(
+            shard_master_bytes * (1.0 + opt_ratio) * (dcn - 1) / dcn)
     return {
+        "secondary": {"replica_bytes": secondary_bytes, "hpz": hpz,
+                      "dcn": dcn},
         "format": LEDGER_FORMAT,
         "zero_stage": int(engine.config.zero_config.stage),
         "offload_optimizer": (ocfg.device if ocfg.enabled else "none"),
@@ -337,7 +395,8 @@ def plan_capacity(*, compute_params_bytes: float, grads_bytes: float,
                   hbm_limit_bytes: Optional[float] = None,
                   chosen_stage: int = 0, chosen_offload: bool = False,
                   offload_compute_params_bytes: Optional[float] = None,
-                  total_params: int = 0) -> Dict[str, Any]:
+                  total_params: int = 0,
+                  hpz_secondary_bytes: float = 0.0) -> Dict[str, Any]:
     """Project per-device bytes for every (ZeRO stage 0–3) × (optimizer
     offload off/on) combination from the model's full-tree component
     totals — the reference stage2/stage3 estimators' arithmetic
@@ -352,7 +411,14 @@ def plan_capacity(*, compute_params_bytes: float, grads_bytes: float,
     0), but an optimizer-offload run always materializes a
     device-resident compute tree while the master lives host-side — so
     its rows need the fp32 copy back. Defaults to
-    ``compute_params_bytes`` (correct for mixed precision)."""
+    ``compute_params_bytes`` (correct for mixed precision).
+
+    ``hpz_secondary_bytes``: the ZeRO++ hpZ secondary-replica charge
+    from the ledger (per-device bytes the intra-slice replica costs vs
+    the global (dcn x data) primary partition). Recorded in the plan —
+    with the companion ``hpz_global_primary_savings_bytes`` alias — so
+    capacity planning can project the "flip hpz off / widen the primary"
+    lever next to the stage/offload/microbatch ones."""
     n = max(int(num_shards), 1)
     c_off = (float(offload_compute_params_bytes)
              if offload_compute_params_bytes is not None
@@ -425,6 +491,12 @@ def plan_capacity(*, compute_params_bytes: float, grads_bytes: float,
                             else None),
         "rows": rows,
         "microbatch_projection": micro_proj,
+        # ZeRO++ hpZ: what the intra-slice secondary replica costs —
+        # equivalently, what widening the primary partition to the full
+        # (dcn x data) world would save per device (at the price of
+        # quantized param gathers crossing DCN).
+        "hpz_secondary_bytes": int(hpz_secondary_bytes),
+        "hpz_global_primary_savings_bytes": int(hpz_secondary_bytes),
     }
 
 
@@ -517,6 +589,8 @@ class MemoryObservatory:
             per["model_state_bytes"])
         reg.gauge("memory/ledger_host_bytes").set(
             ledger["host"]["total_bytes"])
+        reg.gauge("memory/ledger_secondary_bytes").set(
+            ledger.get("secondary", {}).get("replica_bytes", 0))
 
     def hbm_limit_bytes(self) -> Optional[int]:
         """min ``bytes_limit`` over local devices, else the config
@@ -552,7 +626,9 @@ class MemoryObservatory:
             hbm_limit_bytes=self.hbm_limit_bytes(),
             chosen_stage=ledger["zero_stage"],
             chosen_offload=ledger["offload_optimizer"] != "none",
-            total_params=ledger["total_params"])
+            total_params=ledger["total_params"],
+            hpz_secondary_bytes=float(
+                ledger.get("secondary", {}).get("replica_bytes", 0)))
         log_dist("memory observatory what-if:\n"
                  + render_plan_table(self.last_plan), ranks=[0])
         chosen = next(r for r in self.last_plan["rows"] if r["chosen"])

@@ -83,6 +83,8 @@ NUMERICS_METRIC_TAGS = frozenset({
     "numerics/dcn_quant_max_abs_err",
     "numerics/kv_quant_rel_err",
     "numerics/kv_quant_max_abs_err",
+    "numerics/param_quant_rel_err",
+    "numerics/param_quant_max_abs_err",
 })
 
 
@@ -255,6 +257,18 @@ class NumericsObservatory:
                     float(qerr[b, 0]), step=step, bucket=b)
                 reg.gauge("numerics/dcn_quant_max_abs_err").set(
                     float(qerr[b, 1]), step=step, bucket=b)
+        # ZeRO++ qwZ: the lossy PARAM hop (comm/grad_sync.py
+        # ParamGatherPlan) — one (rel-L2, max-abs) pair per step, the
+        # end-to-end round-trip error of the quantized weight all-gather
+        # vs the fp32 master. Same opt-in/zero-overhead contract as the
+        # DCN pair; absent unless the engine's zeropp tier is lossy.
+        pq = host.get("param_qerr")
+        if pq is not None and np.size(pq):
+            pq = np.asarray(pq, np.float64).reshape(-1)
+            reg.gauge("numerics/param_quant_rel_err").set(
+                float(pq[0]), step=step)
+            reg.gauge("numerics/param_quant_max_abs_err").set(
+                float(pq[1]), step=step)
 
     # -- guardrails integration ------------------------------------------
     def worst_group(self) -> Optional[str]:

@@ -16,6 +16,7 @@ for tier-1.
 import os
 import re
 
+from deepspeed_tpu.comm.grad_sync import COMM_PARAM_METRIC_TAGS
 from deepspeed_tpu.serving.engine import SERVING_METRIC_TAGS
 from deepspeed_tpu.telemetry.devicetime import DEVICETIME_METRIC_TAGS
 from deepspeed_tpu.telemetry.fleet import FLEET_METRIC_TAGS
@@ -36,6 +37,7 @@ _MEMORY_TOKEN_RE = re.compile(r"memory/[A-Za-z_]+")
 _SERVING_TOKEN_RE = re.compile(r"serving/[A-Za-z_]+")
 _DEVICETIME_TOKEN_RE = re.compile(r"devicetime/[A-Za-z_]+")
 _NUMERICS_TOKEN_RE = re.compile(r"numerics/[A-Za-z_]+")
+_COMM_PARAMS_TOKEN_RE = re.compile(r"comm/[A-Za-z_]+_params")
 
 
 def _iter_py_files():
@@ -163,6 +165,30 @@ class TestDocDrift:
         # enforcement (it is a DEVICETIME_METRIC_TAGS member)
         assert "comm/measured_exposed_frac" in DEVICETIME_METRIC_TAGS
         assert "comm/measured_exposed_frac" in doc
+
+    def test_comm_param_tags_documented_and_vice_versa(self):
+        """The ZeRO++ param-hop comm gauges (comm/grad_sync.py
+        COMM_PARAM_METRIC_TAGS) are pinned in BOTH directions: every tag
+        the ParamGatherPlan can emit must be in the doc, every
+        comm/*_params token the doc names must be one the code emits,
+        and every literal *_params emission in the tree is a declared
+        tag — so fleet/devicetime dashboards can rely on the param-vs-
+        grad traffic split staying documented."""
+        doc = _doc_text()
+        undocumented = sorted(t for t in COMM_PARAM_METRIC_TAGS
+                              if t not in doc)
+        assert not undocumented, undocumented
+        doc_tokens = set(_COMM_PARAMS_TOKEN_RE.findall(doc))
+        phantom = sorted(t for t in doc_tokens
+                         if t not in COMM_PARAM_METRIC_TAGS)
+        assert not phantom, (
+            f"docs/OBSERVABILITY.md names comm param tags the code never "
+            f"emits: {phantom}")
+        emitted = {t for _, _, t in _emitted_literals()
+                   if _COMM_PARAMS_TOKEN_RE.fullmatch(t)}
+        assert emitted, "the scan must see the param-hop emissions"
+        assert emitted <= COMM_PARAM_METRIC_TAGS, (
+            emitted - COMM_PARAM_METRIC_TAGS)
 
     def test_numerics_tags_documented_and_vice_versa(self):
         """The numerics surface (telemetry/numerics.py) is pinned in

@@ -1,0 +1,768 @@
+"""ZeRO++ weight path (ISSUE 12): qwZ quantized weight all-gather +
+hpZ hierarchical secondary partition + sharded optimizer apply
+(arXiv 2306.10209, weight-update sharding arXiv 2004.13336;
+docs/PERFORMANCE.md "ZeRO++ weight path").
+
+The acceptance ladder on the virtual 2-slice mesh:
+
+- a default-off ``zeropp`` block lowers a **bit-identical** step vs a
+  zeropp-less config (the PR 4 off-identity contract);
+- the explicit gather round-trips within the blockwise-int8 bound at
+  blocks {256, 1024} and the fp32 passthrough (hpZ alone) is EXACT —
+  an all-gather is not a reduction, so the hpZ tier is an equality
+  rung, not a tolerance one;
+- int8 stays within rtol 2e-2 of the implicit path over a tiny-GPT
+  trajectory (mirroring test_dcn's DCN-grad tolerance);
+- with hpZ on, the jitted fwd/bwd contains ZERO cross-slice (dcn-axis)
+  param collectives — jaxpr-asserted — while the global primary
+  (hpz off) gathers over (dcn, data) and shards the optimizer apply
+  over the full world;
+- the memory ledger charges the hpZ secondary replica and the capacity
+  planner projects it;
+- the new numerics gauge keeps the zero-overhead contract.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+INT8 = {"quantized_weights": "int8", "quant_block_size": 256, "hpz": "on"}
+
+
+def mlp_loss_fn(params, batch, rng):
+    h = jnp.tanh(batch["x"] @ params["w1"])
+    pred = h @ params["w2"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def mlp_params():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    return {"w1": jax.random.normal(k1, (16, 64)) * 0.1,
+            "w2": jax.random.normal(k2, (64, 8)) * 0.1}
+
+
+def make_batches(rng, gas, bs):
+    return {"x": rng.standard_normal((gas, bs, 16)).astype(np.float32),
+            "y": rng.standard_normal((gas, bs, 8)).astype(np.float32)}
+
+
+def build(mesh, zeropp=None, stage=3, comm=None, config_extra=None,
+          **init_kwargs):
+    zcfg = {"stage": stage, "stage3_param_persistence_threshold": 0}
+    if zeropp is not None:
+        zcfg["zeropp"] = zeropp
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": zcfg,
+    }
+    if comm is not None:
+        config["comm"] = comm
+    if config_extra:
+        config.update(config_extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn, params=mlp_params(), mesh=mesh, config=config,
+        **init_kwargs)
+    return engine
+
+
+def make_gpt_engine(zeropp, telemetry=None):
+    from deepspeed_tpu.models import make_gpt
+
+    model, cfg = make_gpt("tiny", num_layers=2, dropout_rate=0.0,
+                          dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (8, 16), dtype=np.int32)
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)},
+                        {"input_ids": ids})["params"]
+    zcfg = {"stage": 3, "stage3_param_persistence_threshold": 0}
+    if zeropp:
+        zcfg["zeropp"] = zeropp
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": zcfg,
+    }
+    if telemetry:
+        config["telemetry"] = telemetry
+        config["steps_per_print"] = 1
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, params=params, mesh=build_mesh(slices=2),
+        config=config)
+    return engine, cfg
+
+
+def _collective_blocks(txt):
+    """Every collective primitive's param block in a jaxpr string."""
+    return re.findall(
+        r"(?:all_gather|all_to_all|psum2?|ppermute)\[(.*?)\]", txt, re.S)
+
+
+class TestOffIdentity:
+    def test_default_off_bit_identical_lowered_step(self, eight_devices):
+        """An explicitly-inert zeropp block ({off, off}) produces a
+        jaxpr string-identical to a zeropp-less stage-3 config, with no
+        explicit collectives at all (the implicit path has none)."""
+        rng = np.random.default_rng(0)
+        batches = make_batches(rng, 2, 16)
+        base = build(build_mesh(slices=2))
+        off = build(build_mesh(slices=2),
+                    zeropp={"quantized_weights": "off", "hpz": "off"})
+        assert base.param_gather_plan is None
+        assert off.param_gather_plan is None
+        pb = base.put_batch(batches, leading_gas_dim=True)
+        jx_base = str(base._train_step.trace(
+            base.state, pb, jnp.float32(1e-2)).jaxpr)
+        jx_off = str(off._train_step.trace(
+            off.state, pb, jnp.float32(1e-2)).jaxpr)
+        assert jx_base == jx_off
+        assert "all_gather" not in jx_off
+
+    def test_specs_unchanged_when_off(self, eight_devices):
+        base = build(build_mesh(slices=2))
+        off = build(build_mesh(slices=2),
+                    zeropp={"quantized_weights": "off", "hpz": "off"})
+        assert base.param_specs == off.param_specs
+        assert base.opt_specs == off.opt_specs
+
+
+class TestQwZRoundtrip:
+    """The gather itself, against ground truth: int8 bounded by the
+    blockwise-RTNE error, fp32 passthrough exact."""
+
+    @pytest.mark.parametrize("block", [256, 1024])
+    def test_int8_gather_roundtrip_bounded(self, eight_devices, block):
+        eng = build(build_mesh(slices=2),
+                    zeropp={"quantized_weights": "int8",
+                            "quant_block_size": block, "hpz": "on"})
+        plan = eng.param_gather_plan
+        assert plan is not None and plan.bits == 8
+        with eng.mesh:
+            full, _ = jax.jit(lambda p: plan.gather(p))(eng.state.params)
+        ref = jax.device_get(eng.state.params)
+        out = jax.device_get(full)
+        for k in ref:
+            amax = np.abs(ref[k]).max()
+            err = np.abs(out[k] - ref[k]).max()
+            # Symmetric int8 RTNE: per-element error <= blockmax/254 <=
+            # leafmax/254 (blocks are shard-local flat runs).
+            assert err <= amax / 254 + 1e-7, (k, err, amax)
+
+    def test_param_qerr_counts_each_unique_shard_once(self, eight_devices):
+        """Mixed tree under the hpz=off global primary: a (data,)-only
+        fallback leaf is dcn-replicated inside the manual region, and
+        the psum over {dcn, data} would count its error parts dcn times
+        — the plan must pre-divide by the replication factor so the
+        emitted rel-L2 equals the unweighted round-trip error over every
+        UNIQUE shard, exactly once each."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.comm.grad_sync import ParamGatherPlan
+        from deepspeed_tpu.comm.quantize import (rel_from_parts,
+                                                 roundtrip_error_parts)
+        from deepspeed_tpu.runtime.zero.config import ZeroConfig
+
+        mesh = build_mesh(slices=2)          # dcn2 x data4
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((8, 4)).astype(np.float32)
+        b = rng.standard_normal((12, 4)).astype(np.float32)
+        specs = {"a": P(("dcn", "data"), None),     # 8 unique shards
+                 "b": P("data", None)}              # 4, dcn-replicated
+        params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                  for k, v in (("a", a), ("b", b))}
+        zpp = ZeroConfig.from_dict({"stage": 3, "zeropp": {
+            "quantized_weights": "int8", "hpz": "off"}}).zeropp
+        plan = ParamGatherPlan(zpp, mesh, param_template=params,
+                               param_specs=specs, measure_quant_error=True)
+        with mesh:
+            full, qerr = jax.jit(lambda p: plan.gather(p))(params)
+        qerr = np.asarray(jax.device_get(qerr))
+        np.testing.assert_allclose(np.asarray(full["a"]), a, atol=0.05)
+        np.testing.assert_allclose(np.asarray(full["b"]), b, atol=0.05)
+
+        def parts(x, shards):
+            es = rs = ms = 0.0
+            for s in np.split(x, shards):        # shard-local flat runs
+                flat = s.reshape(-1)
+                pad = (-len(flat)) % 256
+                flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+                e, r, m = (float(v) for v in roundtrip_error_parts(
+                    jnp.asarray(flat), 8, 256))
+                es, rs, ms = es + e, rs + r, max(ms, m)
+            return es, rs, ms
+
+        ea, ra, ma = parts(a, 8)
+        eb, rb, mb = parts(b, 4)                 # once per UNIQUE shard
+        want = float(rel_from_parts(jnp.float32(ea + eb),
+                                    jnp.float32(ra + rb)))
+        np.testing.assert_allclose(qerr[0], want, rtol=1e-5)
+        np.testing.assert_allclose(qerr[1], max(ma, mb), rtol=1e-5)
+
+    def test_fp32_passthrough_gather_exact(self, eight_devices):
+        eng = build(build_mesh(slices=2), zeropp={"hpz": "on"})
+        plan = eng.param_gather_plan
+        assert plan is not None and plan.bits == 32
+        with eng.mesh:
+            full, qerr = jax.jit(lambda p: plan.gather(p))(
+                eng.state.params)
+        assert qerr is None          # nothing lossy to measure
+        ref = jax.device_get(eng.state.params)
+        out = jax.device_get(full)
+        for k in ref:
+            np.testing.assert_array_equal(out[k], ref[k])
+
+
+class TestParityLadder:
+    def test_fp32_passthrough_tracks_plain_stage3_exactly(
+            self, eight_devices):
+        """hpZ alone (fp32 wire): the gather is lossless and elementwise
+        — the trajectory must EQUAL plain stage-3 to float tolerance
+        (tighter than the grad-sync ulp rung: no reduction reordering
+        is involved in an all-gather)."""
+        rng = np.random.default_rng(1)
+        batches = [make_batches(rng, 2, 16) for _ in range(5)]
+        plain = build(build_mesh(slices=2))
+        hpz = build(build_mesh(slices=2), zeropp={"hpz": "on"})
+        for b in batches:
+            lp = float(plain.train_batch({k: v.copy() for k, v in b.items()}))
+            lh = float(hpz.train_batch({k: v.copy() for k, v in b.items()}))
+            np.testing.assert_allclose(lh, lp, rtol=1e-6, atol=1e-7)
+
+    @pytest.mark.parametrize("zeropp,tol", [
+        (dict(INT8), 2e-2),
+        ({"quantized_weights": "bf16", "hpz": "off"}, 5e-3),
+    ])
+    def test_quantized_rungs_track_plain(self, eight_devices, zeropp, tol):
+        """int8 intra-slice and bf16 global-primary both stay within
+        tolerance of the implicit path (the global rung also exercises
+        the (dcn, data) stitch order — a misordered reconstruction
+        explodes immediately)."""
+        rng = np.random.default_rng(2)
+        batches = [make_batches(rng, 2, 16) for _ in range(4)]
+        plain = build(build_mesh(slices=2))
+        on = build(build_mesh(slices=2), zeropp=zeropp)
+        for b in batches:
+            lp = float(plain.train_batch({k: v.copy() for k, v in b.items()}))
+            lh = float(on.train_batch({k: v.copy() for k, v in b.items()}))
+            assert np.isfinite(lh)
+            np.testing.assert_allclose(lh, lp, rtol=tol, atol=tol)
+
+    def test_int8_gpt_trajectory(self, eight_devices):
+        """Short tiny-GPT trajectory: qwZ-int8 stays within rtol 2e-2 of
+        the implicit stage-3 path and still trains (mirrors
+        test_dcn.test_int8_gpt_trajectory's DCN-grad rung)."""
+        plain, cfg = make_gpt_engine(None)
+        on, _ = make_gpt_engine(dict(INT8))
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, cfg.vocab_size, (2, 16, 16), dtype=np.int32)
+        losses_p, losses_on = [], []
+        for _ in range(5):
+            losses_p.append(float(plain.train_batch(
+                {"input_ids": ids.copy()})))
+            losses_on.append(float(on.train_batch(
+                {"input_ids": ids.copy()})))
+        losses_p, losses_on = np.array(losses_p), np.array(losses_on)
+        assert np.isfinite(losses_on).all()
+        np.testing.assert_allclose(losses_on, losses_p, rtol=2e-2)
+        assert losses_on[-1] < losses_on[0]      # still trains
+
+    def test_zeropp_is_fused_only(self, eight_devices):
+        """An active zeropp block disables the per-microbatch program
+        (the explicit gather is a collective — one per optimizer step,
+        like the hierarchical/1-bit/offload tiers): forward()/backward()
+        stash-and-fuse, and the trajectory matches train_batch exactly."""
+        eng = build(build_mesh(slices=2), zeropp=dict(INT8))
+        assert eng._micro_step is None and eng._apply_step is None
+        rng = np.random.default_rng(9)
+        b = make_batches(rng, 2, 16)
+        micros = [{k: v[i] for k, v in b.items()} for i in range(2)]
+        for _ in range(2):
+            for m in micros:
+                eng.forward(m)
+                eng.backward()
+            eng.step()
+        ref = build(build_mesh(slices=2), zeropp=dict(INT8))
+        for _ in range(2):
+            ref.train_batch(b)
+        for a, c in zip(jax.tree_util.tree_leaves(eng.state.params),
+                        jax.tree_util.tree_leaves(ref.state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+    def test_composes_with_hierarchical_grad_sync(self, eight_devices):
+        """qwZ + the hierarchical int8 grad sync: both lossy hops in one
+        step, trajectory still within tolerance of the fully-implicit
+        path."""
+        rng = np.random.default_rng(4)
+        plain = build(build_mesh(slices=2))
+        both = build(build_mesh(slices=2), zeropp=dict(INT8),
+                     comm={"hierarchical": "on", "dcn_quant_bits": 8,
+                           "quant_block_size": 256})
+        assert both.grad_sync_plan is not None
+        assert both.param_gather_plan is not None
+        for b in [make_batches(rng, 2, 16) for _ in range(3)]:
+            lp = float(plain.train_batch({k: v.copy() for k, v in b.items()}))
+            lh = float(both.train_batch({k: v.copy() for k, v in b.items()}))
+            assert np.isfinite(lh)
+            np.testing.assert_allclose(lh, lp, rtol=3e-2, atol=3e-2)
+
+
+class TestHpZPlacement:
+    def test_hpz_zero_cross_slice_param_collectives(self, eight_devices):
+        """THE hpZ claim, at the jaxpr level: with hpz on the traced
+        train_step's collectives never name the dcn axis — the explicit
+        int8 param gather (all_gather of i8 codes) rides data only."""
+        on = build(build_mesh(slices=2), zeropp=dict(INT8))
+        rng = np.random.default_rng(5)
+        pb = on.put_batch(make_batches(rng, 2, 16), leading_gas_dim=True)
+        txt = str(on._train_step.trace(
+            on.state, pb, jnp.float32(1e-2)).jaxpr)
+        ags = re.findall(r"all_gather\[(.*?)\]", txt, re.S)
+        assert ags, "no explicit param gather in the hpZ jaxpr"
+        blocks = _collective_blocks(txt)
+        assert blocks and not any("dcn" in b for b in blocks), \
+            [b[:120] for b in blocks if "dcn" in b][:1]
+        assert "i8[" in txt, "no int8 wire arrays in the step"
+
+    def test_global_primary_gathers_over_dcn(self, eight_devices):
+        """hpz off (block active): the primary partition spans
+        (dcn, data) — master/opt shard 8-way, the gather's collectives
+        name dcn, and the sharded optimizer apply updates 1/(dcn*data)
+        shards."""
+        from jax.sharding import PartitionSpec as P
+
+        glob = build(build_mesh(slices=2),
+                     zeropp={"quantized_weights": "int8",
+                             "quant_block_size": 256, "hpz": "off"})
+        assert glob.param_specs["w1"] == P(None, ("dcn", "data"))
+        assert glob.opt_specs["w1"] == P(None, ("dcn", "data"))
+        m = glob.state.opt_state.exp_avg["w1"]
+        shard_elems = int(np.prod(m.sharding.shard_shape(m.shape)))
+        assert shard_elems == 16 * 64 // 8, shard_elems
+        rng = np.random.default_rng(6)
+        pb = glob.put_batch(make_batches(rng, 2, 16), leading_gas_dim=True)
+        txt = str(glob._train_step.trace(
+            glob.state, pb, jnp.float32(1e-2)).jaxpr)
+        ags = re.findall(r"all_gather\[(.*?)\]", txt, re.S)
+        assert ags and any("dcn" in a for a in ags)
+
+    def test_global_primary_falls_back_to_data_axis(self, eight_devices):
+        """hpz off: a leaf whose dims divide data (4) but not dcn*data
+        (8) must fall back to the intra-slice (data,) partition — NEVER
+        to full replication (plain stage 3 sharded it over data, and the
+        maximal-HBM-savings mode can't do worse); the moments follow the
+        same fallback, and the gather plan still gathers the leaf (over
+        data only, like an hpZ leaf) instead of calling it persistent."""
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.comm.grad_sync import ParamGatherPlan
+        from deepspeed_tpu.runtime.zero.config import ZeroConfig
+        from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
+
+        mesh = build_mesh(slices=2)          # dcn2 x data4
+        zpp = {"zeropp": {"quantized_weights": "int8", "hpz": "off"}}
+        plain = ZeroPartitioner(mesh, ZeroConfig.from_dict(
+            {"stage": 3, "stage3_param_persistence_threshold": 0}))
+        glob = ZeroPartitioner(mesh, ZeroConfig.from_dict(
+            {"stage": 3, "stage3_param_persistence_threshold": 0, **zpp}))
+        assert plain.param_spec((12, 3)) == P("data", None)
+        assert glob.param_spec((12, 3)) == P("data", None)
+        assert glob.opt_state_spec((12, 3)) == P("data", None)
+        # dcn*data-divisible dims still take the global primary.
+        assert glob.param_spec((16, 3)) == P(("dcn", "data"), None)
+        plan = ParamGatherPlan(
+            ZeroConfig.from_dict({"stage": 3, **zpp}).zeropp, mesh,
+            param_template={"w": jnp.zeros((12 // 4, 3))},
+            param_specs={"w": glob.param_spec((12, 3))})
+        assert [a for _, _, a in plan.gathered] == [("data",)]
+
+    def test_hpz_keeps_intra_slice_partition(self, eight_devices):
+        """hpz on: master/opt shard over data only (4-way — the
+        dcn-replicated secondary layout the ledger charges)."""
+        on = build(build_mesh(slices=2), zeropp=dict(INT8))
+        m = on.state.opt_state.exp_avg["w1"]
+        shard_elems = int(np.prod(m.sharding.shard_shape(m.shape)))
+        assert shard_elems == 16 * 64 // 4, shard_elems
+
+    def test_modeled_param_bytes_ladder(self, eight_devices):
+        """hpZ: dcn param bytes structurally 0; int8: >= 3.5x modeled
+        compression; global: dcn share = (dcn-1)/dcn of the payload."""
+        hpz = build(build_mesh(slices=2), zeropp=dict(INT8))
+        m = hpz.param_gather_plan.modeled_bytes()
+        assert m["bytes_dcn_params"] == 0
+        assert m["bytes_ici_params"] > 0
+        assert m["compression_ratio"] >= 3.5
+        assert m["fallback_elems"] == 0      # plain MLP: everything gathers
+        glob = build(build_mesh(slices=2),
+                     zeropp={"quantized_weights": "int8",
+                             "quant_block_size": 256, "hpz": "off"})
+        g = glob.param_gather_plan.modeled_bytes()
+        assert g["bytes_dcn_params"] > 0
+        assert g["bytes_dcn_params"] == g["bytes_ici_params"]  # dcn=2
+
+
+class TestAccounting:
+    def test_ledger_charges_secondary_replica(self, eight_devices,
+                                              tmp_path):
+        """memory/ledger_secondary_bytes = (1 - 1/dcn) x the per-device
+        fp32 state of the dcn-shardable (gathered) leaves under hpZ,
+        recorded in the ledger AND projected by plan_capacity
+        (hpz_secondary_bytes); 0 for the global primary and for
+        zeropp-less engines."""
+        from deepspeed_tpu.telemetry.registry import InMemorySink
+
+        on = build(build_mesh(slices=2), zeropp=dict(INT8),
+                   config_extra={"telemetry": {
+                       "enabled": True, "dir": str(tmp_path),
+                       "memory": {"enabled": True}}})
+        led = on.memory.last_ledger
+        assert led["secondary"]["hpz"]
+        ratio = led["full"]["optimizer_bytes"] / led["full"]["master_bytes"]
+        shard_master = (16 * 64 + 64 * 8) // 4 * 4   # data=4 shards, fp32
+        expect = int(shard_master * (1 + ratio) / 2)
+        assert led["secondary"]["replica_bytes"] == expect > 0
+        # The gathered compute tree is FULL per device (the explicit
+        # all-gather replicates it) — a pure-fp32 run books that copy.
+        assert led["per_device"]["compute_params_bytes"] \
+            == (16 * 64 + 64 * 8) * 4
+        # Not double-counted into the device model-state sum.
+        assert led["per_device"]["model_state_bytes"] == sum(
+            v for k, v in led["per_device"].items()
+            if k != "model_state_bytes")
+        assert on.memory.last_plan["hpz_secondary_bytes"] == expect
+        assert (on.memory.last_plan["hpz_global_primary_savings_bytes"]
+                == expect)
+        mem = on.telemetry.registry.add_sink(InMemorySink())
+        on.memory._emit_ledger(led)
+        rows = {r["tag"]: r["value"] for r in mem.rows}
+        assert rows["memory/ledger_secondary_bytes"] == expect
+
+        off = build(build_mesh(slices=2),
+                    config_extra={"telemetry": {
+                        "enabled": True, "dir": str(tmp_path / "off"),
+                        "memory": {"enabled": True}}})
+        assert off.memory.last_ledger["secondary"]["replica_bytes"] == 0
+
+        # The fp32-passthrough hpZ tier has the identical dcn-replicated
+        # placement — the charge is a placement property, independent of
+        # the wire dtype.
+        fp32 = build(build_mesh(slices=2), zeropp={"hpz": "on"},
+                     config_extra={"telemetry": {
+                         "enabled": True, "dir": str(tmp_path / "fp32"),
+                         "memory": {"enabled": True}}})
+        assert (fp32.memory.last_ledger["secondary"]["replica_bytes"]
+                == expect)
+
+    def test_secondary_charge_excludes_non_dcn_shardable_leaves(
+            self, eight_devices, tmp_path):
+        """A leaf whose dims divide data but not dcn x data falls back
+        to the SAME (data,) partition under the global primary, so
+        flipping hpz off saves nothing on it — the ledger's secondary
+        charge must scale by the dcn-shardable fraction, not bill the
+        whole fp32 state."""
+        from deepspeed_tpu.telemetry.memory import model_state_ledger
+
+        def loss(p, b, r):
+            h = jnp.tanh(b["x"] @ p["w1"])
+            reg = 1e-6 * jnp.sum(p["wx"] ** 2)
+            return jnp.mean((h @ p["w2"] - b["y"]) ** 2) + reg
+
+        k = jax.random.split(jax.random.PRNGKey(0), 3)
+        params = {"w1": jax.random.normal(k[0], (16, 64)) * 0.1,
+                  "w2": jax.random.normal(k[1], (64, 8)) * 0.1,
+                  # 12 % 4 == 0 but 12 % 8 != 0: (data,)-fallback leaf.
+                  "wx": jax.random.normal(k[2], (12, 12)) * 0.1}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            loss_fn=loss, params=params, mesh=build_mesh(slices=2),
+            config={
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {
+                    "stage": 3, "stage3_param_persistence_threshold": 0,
+                    "zeropp": dict(INT8)}})
+        led = model_state_ledger(engine)
+        ratio = led["full"]["optimizer_bytes"] / led["full"]["master_bytes"]
+        shard_master = (16 * 64 + 64 * 8) // 4 * 4  # wx's elems excluded
+        expect = int(shard_master * (1 + ratio) / 2)
+        assert led["secondary"]["replica_bytes"] == expect > 0
+
+        # A base spec that already pins the data axis (the TiledLinear
+        # shape) early-returns under the global primary too — flipping
+        # hpz off gains nothing on that leaf, so it leaves the charge.
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+        from deepspeed_tpu.runtime.engine import TPUEngine
+
+        pinned = TPUEngine(
+            loss_fn=mlp_loss_fn, params=mlp_params(),
+            config=DeepSpeedTPUConfig({
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {
+                    "stage": 3, "stage3_param_persistence_threshold": 0,
+                    "zeropp": dict(INT8)}}),
+            mesh=build_mesh(slices=2),
+            param_partition_specs={"w1": P(None, "data"), "w2": None})
+        led = model_state_ledger(pinned)
+        ratio = led["full"]["optimizer_bytes"] / led["full"]["master_bytes"]
+        shard_master = (64 * 8) // 4 * 4        # w1 base-pinned: excluded
+        assert led["secondary"]["replica_bytes"] \
+            == int(shard_master * (1 + ratio) / 2) > 0
+
+    def test_secondary_charge_counts_tp_fallback_leaves(
+            self, eight_devices):
+        """A TP-sharded leaf rides the implicit gather path (fallback),
+        but its free dim still carries the primary placement — a global
+        (hpz off) primary would spread it over dcn, so the hpZ secondary
+        charge must bill its shard bytes like a gathered leaf's."""
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+        from deepspeed_tpu.runtime.engine import TPUEngine
+        from deepspeed_tpu.telemetry.memory import model_state_ledger
+
+        engine = TPUEngine(
+            loss_fn=mlp_loss_fn, params=mlp_params(),
+            config=DeepSpeedTPUConfig({
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {
+                    "stage": 3, "stage3_param_persistence_threshold": 0,
+                    "zeropp": dict(INT8)}}),
+            mesh=build_mesh(slices=2, model=2),
+            param_partition_specs={"w1": P(None, "model"), "w2": None})
+        plan = engine.param_gather_plan
+        assert plan.fallback_elems == 16 * 64          # w1: TP fallback
+        assert [s for s, _, _ in plan.fallback_leaves()] == [(16, 64)]
+        led = model_state_ledger(engine)
+        ratio = led["full"]["optimizer_bytes"] / led["full"]["master_bytes"]
+        # dcn=2 x data=2 x model=2: w2 gathered over (data,), w1 sharded
+        # over (data, model) — BOTH dcn-shardable under the global
+        # primary, both billed at their per-device shard elems.
+        shard_master = (64 * 8 // 2 + 16 * 64 // 4) * 4
+        assert led["secondary"]["replica_bytes"] \
+            == int(shard_master * (1 + ratio) / 2) > 0
+
+    def test_comm_param_gauges_and_numerics_gauge(self, eight_devices,
+                                                  tmp_path):
+        """comm/bytes_dcn_params + comm/bytes_ici_params land each step;
+        with telemetry.numerics on, numerics/param_quant_rel_err /
+        _max_abs_err land at the flush and measure < 1e-1."""
+        from deepspeed_tpu.telemetry.registry import InMemorySink
+
+        on = build(build_mesh(slices=2), zeropp=dict(INT8),
+                   config_extra={"steps_per_print": 1,
+                                 "telemetry": {
+                                     "enabled": True, "dir": str(tmp_path),
+                                     "numerics": {"enabled": True}}})
+        rng = np.random.default_rng(7)
+        on.train_batch(make_batches(rng, 2, 16))
+        mem = on.telemetry.registry.add_sink(InMemorySink())
+        on.train_batch(make_batches(rng, 2, 16))
+        tags = {r["tag"] for r in mem.rows}
+        assert {"comm/bytes_dcn_params", "comm/bytes_ici_params",
+                "numerics/param_quant_rel_err",
+                "numerics/param_quant_max_abs_err"} <= tags
+        rel = [r["value"] for r in mem.rows
+               if r["tag"] == "numerics/param_quant_rel_err"]
+        assert rel and all(0 < v < 1e-1 for v in rel), rel
+
+    def test_zero_overhead_numerics_contract(self, eight_devices,
+                                             tmp_path):
+        """The new gauge keeps the observatory contract: a qwZ engine
+        with telemetry on but numerics OFF lowers the identical step as
+        one with telemetry absent (no measurement ops ride along), and
+        its plan does not measure."""
+        rng = np.random.default_rng(8)
+        batches = make_batches(rng, 2, 16)
+        bare = build(build_mesh(slices=2), zeropp=dict(INT8))
+        tel = build(build_mesh(slices=2), zeropp=dict(INT8),
+                    config_extra={"telemetry": {"enabled": True,
+                                                "dir": str(tmp_path)}})
+        assert not bare.param_gather_plan.measure_quant
+        assert not tel.param_gather_plan.measure_quant
+        pb = bare.put_batch(batches, leading_gas_dim=True)
+        jx_bare = str(bare._train_step.trace(
+            bare.state, pb, jnp.float32(1e-2)).jaxpr)
+        jx_tel = str(tel._train_step.trace(
+            tel.state, pb, jnp.float32(1e-2)).jaxpr)
+        assert jx_bare == jx_tel
+
+    def test_param_hop_in_modeled_exposed_frac(self, eight_devices,
+                                               tmp_path):
+        """zeropp WITHOUT the hierarchical sync still emits the modeled
+        comm/exposed_frac, fed by the param gather's wire time (it runs
+        before the fused fwd/bwd, fully exposed) — previously the gauge
+        only existed with a grad-sync plan, so the device-time
+        observatory's measured-vs-modeled divergence warning fired by
+        construction whenever qwZ rode alone."""
+        from deepspeed_tpu.telemetry.registry import InMemorySink
+
+        on = build(build_mesh(slices=2), zeropp=dict(INT8),
+                   config_extra={"steps_per_print": 1,
+                                 "telemetry": {
+                                     "enabled": True,
+                                     "dir": str(tmp_path)}})
+        assert on.grad_sync_plan is None      # the param hop is alone
+        rng = np.random.default_rng(10)
+        on.train_batch(make_batches(rng, 2, 16))
+        mem = on.telemetry.registry.add_sink(InMemorySink())
+        on.train_batch(make_batches(rng, 2, 16))
+        vals = [r["value"] for r in mem.rows
+                if r["tag"] == "comm/exposed_frac"]
+        assert vals and all(0 < v <= 1 for v in vals), vals
+
+    def test_eval_skips_explicit_gather(self, eight_devices):
+        """eval_batch — and the reference API's forward() probe loss
+        that rides it — stays on the IMPLICIT full-precision path: the
+        probe runs once per microbatch, so the explicit gather there
+        would cost gas extra collectives per optimizer step outside the
+        one-gather-per-step comm/bytes_*_params model. The qwZ engine's
+        eval jaxpr carries no int8 wire arrays and equals plain
+        stage-3's exactly."""
+        on = build(build_mesh(slices=2), zeropp=dict(INT8))
+        plain = build(build_mesh(slices=2))
+        rng = np.random.default_rng(11)
+        b = make_batches(rng, 2, 16)
+        micro = {k: v[0] for k, v in b.items()}
+        jx_on = str(on._eval_step.trace(on.state, micro).jaxpr)
+        assert "i8[" not in jx_on, "eval must not run the quantized gather"
+        jx_plain = str(plain._eval_step.trace(plain.state, micro).jaxpr)
+        assert jx_on == jx_plain
+
+
+class TestConfigValidation:
+    def test_requires_stage_ge_2(self, eight_devices):
+        from deepspeed_tpu.config.config import ConfigError
+
+        with pytest.raises(ConfigError, match="stage >= 2"):
+            build(build_mesh(slices=2), zeropp={"hpz": "on"}, stage=1)
+
+    def test_rejects_onebit(self, eight_devices):
+        from deepspeed_tpu.config.config import ConfigError
+
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "OneBitAdam",
+                          "params": {"lr": 1e-2, "freeze_step": 2}},
+            "zero_optimization": {"stage": 0,
+                                  "zeropp": {"quantized_weights": "int8"}},
+        }
+        with pytest.raises(ConfigError, match="1-bit"):
+            deepspeed_tpu.initialize(
+                loss_fn=mlp_loss_fn, params=mlp_params(),
+                mesh=build_mesh(slices=2), config=config)
+
+    def test_rejects_offload_param(self, eight_devices):
+        """The zeropp x offload_param combination must fail loudly with
+        the secondary-replica rationale AT CONFIG PARSE — before
+        initialize()'s offload tier ever touches the model (its
+        block-structured conversion would otherwise crash first with an
+        unrelated error)."""
+        from deepspeed_tpu.config.config import (ConfigError,
+                                                 DeepSpeedTPUConfig)
+
+        with pytest.raises(ConfigError, match="offload_param"):
+            DeepSpeedTPUConfig({
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {
+                    "stage": 3,
+                    "zeropp": {"hpz": "on"},
+                    "offload_param": {"device": "cpu"},
+                    "offload_optimizer": {"device": "cpu"}}})
+
+    def test_rejects_offload_optimizer(self, eight_devices):
+        from deepspeed_tpu.config.config import ConfigError
+
+        with pytest.raises(ConfigError, match="offload_optimizer"):
+            build(build_mesh(slices=2),
+                  config_extra={"zero_optimization": {
+                      "stage": 2,
+                      "zeropp": {"quantized_weights": "int8"},
+                      "offload_optimizer": {"device": "cpu"}}})
+
+    def test_rejects_host_implied_offload(self, eight_devices):
+        """'cpuadam' implies the host tier at ENGINE level (no explicit
+        offload_optimizer block for the config-parse wall to see) — the
+        engine must still refuse: the offload builders never run the
+        explicit gather, so an active plan would emit modeled comm
+        gauges and the ledger charge for traffic that does not exist."""
+        from deepspeed_tpu.config.config import ConfigError
+
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "cpuadam", "params": {"lr": 1e-2}},
+            "zero_optimization": {
+                "stage": 2,
+                "zeropp": {"quantized_weights": "int8", "hpz": "on"}},
+        }
+        with pytest.raises(ConfigError, match="host"):
+            deepspeed_tpu.initialize(
+                loss_fn=mlp_loss_fn, params=mlp_params(),
+                mesh=build_mesh(slices=2), config=config)
+
+    def test_rejects_bad_values(self, eight_devices):
+        for bad, match in (({"quantized_weights": "int4"},
+                            "quantized_weights"),
+                           ({"hpz": "maybe"}, "hpz"),
+                           ({"quant_block_size": 0}, "quant_block_size"),
+                           ({"nope": 1}, "unknown")):
+            with pytest.raises(ValueError, match=match):
+                build(build_mesh(slices=2), zeropp=bad)
+
+    def test_stage2_gets_param_partition(self, eight_devices):
+        """qwZ at stage 2: the implicit post-apply param all-gather
+        becomes the explicit partition + gather (params shard like
+        stage 3 once the block is active)."""
+        from jax.sharding import PartitionSpec as P
+
+        s2 = build(build_mesh(slices=2), zeropp=dict(INT8), stage=2)
+        assert s2.param_specs["w1"] == P(None, "data")
+        assert s2.param_gather_plan is not None
+        rng = np.random.default_rng(9)
+        plain = build(build_mesh(slices=2), stage=2)
+        for b in [make_batches(rng, 2, 16) for _ in range(3)]:
+            lp = float(plain.train_batch({k: v.copy() for k, v in b.items()}))
+            lh = float(s2.train_batch({k: v.copy() for k, v in b.items()}))
+            assert np.isfinite(lh)
+            np.testing.assert_allclose(lh, lp, rtol=2e-2, atol=2e-2)
+
+
+class TestProbeCLI:
+    def test_probe_zeropp_selftest_cli(self):
+        """The acceptance probe (ISSUE 12 satellite): modeled-bytes
+        ladder off/hpZ/qwZ-int8, trains-under-each-tier, and the
+        measured param_quant_rel_err gate — in tier-1 via the CLI it
+        ships as."""
+        repo = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)  # the tool forces its own 8-device flag
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "tools", "probe_zeropp.py"), "--selftest"],
+            capture_output=True, text=True, env=env, timeout=540)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert '"pass": true' in proc.stdout
+        assert '"hpz_dcn_param_bytes": 0' in proc.stdout
+        assert "param_quant_rel_err" in proc.stdout
