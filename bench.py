@@ -494,6 +494,11 @@ def main():
         # step programs; a future BENCH round measuring with numerics on
         # must record its block here so rows stay attributable.
         "numerics": "off",
+        # Live elasticity (resilience/elastic.py) off: no SIGTERM handler
+        # and no step-boundary coordinator checks in the timed windows
+        # (the contract says off is free — bit-identical lowered step —
+        # but the env block records the whole config anyway).
+        "elasticity": "off",
         "peak_tflops_per_chip": peak,
         # Gradient-sync strategy the rows were measured under
         # (comm/grad_sync.py): none of the training-section configs set

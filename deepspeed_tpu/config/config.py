@@ -190,6 +190,90 @@ class ResilienceConfig:
 
 
 @dataclass
+class LiveEvictionConfig:
+    """Straggler-eviction knobs of the live-elasticity loop
+    (resilience/elastic.py): evict a fleet-flagged persistent straggler
+    only when the goodput cost model says the projected throughput gain
+    over ``horizon_steps`` beats ``min_gain_factor`` x the measured
+    in-process reshard cost."""
+
+    enabled: bool = C.ELASTICITY_LIVE_EVICTION_ENABLED_DEFAULT
+    horizon_steps: int = C.ELASTICITY_LIVE_EVICTION_HORIZON_DEFAULT
+    min_gain_factor: float = C.ELASTICITY_LIVE_EVICTION_MIN_GAIN_DEFAULT
+    assumed_reshard_sec: float = \
+        C.ELASTICITY_LIVE_EVICTION_ASSUMED_RESHARD_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "LiveEvictionConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.ELASTICITY_LIVE_EVICTION_ENABLED,
+                              C.ELASTICITY_LIVE_EVICTION_ENABLED_DEFAULT)),
+            horizon_steps=int(_get(d, C.ELASTICITY_LIVE_EVICTION_HORIZON,
+                                   C.ELASTICITY_LIVE_EVICTION_HORIZON_DEFAULT)),
+            min_gain_factor=float(_get(
+                d, C.ELASTICITY_LIVE_EVICTION_MIN_GAIN,
+                C.ELASTICITY_LIVE_EVICTION_MIN_GAIN_DEFAULT)),
+            assumed_reshard_sec=float(_get(
+                d, C.ELASTICITY_LIVE_EVICTION_ASSUMED_RESHARD,
+                C.ELASTICITY_LIVE_EVICTION_ASSUMED_RESHARD_DEFAULT)),
+        )
+        if cfg.horizon_steps < 1:
+            raise ConfigError(
+                "elasticity.live.eviction.horizon_steps must be >= 1")
+        if cfg.min_gain_factor <= 0:
+            raise ConfigError(
+                "elasticity.live.eviction.min_gain_factor must be > 0")
+        if cfg.assumed_reshard_sec <= 0:
+            raise ConfigError(
+                "elasticity.live.eviction.assumed_reshard_sec must be > 0")
+        return cfg
+
+
+@dataclass
+class LiveElasticityConfig:
+    """``elasticity.live`` — in-process live elasticity
+    (resilience/elastic.py; docs/RESILIENCE.md "Live elasticity"): catch
+    the preemption advance warning (SIGTERM inside ``grace_seconds``),
+    drain, reshard onto the surviving chips in the SAME process, re-admit
+    a returning slice at the next snapshot boundary, and close the
+    straggler-eviction loop. Disabled (the default) is provably free: no
+    signal handler installed, zero extra syncs, lowered step unchanged."""
+
+    enabled: bool = C.ELASTICITY_LIVE_ENABLED_DEFAULT
+    grace_seconds: float = C.ELASTICITY_LIVE_GRACE_DEFAULT
+    check_interval_steps: int = C.ELASTICITY_LIVE_CHECK_INTERVAL_DEFAULT
+    exit_code: int = C.ELASTIC_PREEMPT_EXIT_CODE_DEFAULT
+    eviction: LiveEvictionConfig = field(default_factory=LiveEvictionConfig)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "LiveElasticityConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.ELASTICITY_LIVE_ENABLED,
+                              C.ELASTICITY_LIVE_ENABLED_DEFAULT)),
+            grace_seconds=float(_get(d, C.ELASTICITY_LIVE_GRACE,
+                                     C.ELASTICITY_LIVE_GRACE_DEFAULT)),
+            check_interval_steps=int(_get(
+                d, C.ELASTICITY_LIVE_CHECK_INTERVAL,
+                C.ELASTICITY_LIVE_CHECK_INTERVAL_DEFAULT)),
+            exit_code=int(_get(d, C.ELASTICITY_LIVE_EXIT_CODE,
+                               C.ELASTIC_PREEMPT_EXIT_CODE_DEFAULT)),
+            eviction=LiveEvictionConfig.from_dict(
+                d.get(C.ELASTICITY_LIVE_EVICTION)),
+        )
+        if cfg.enabled and cfg.grace_seconds <= 0:
+            raise ConfigError("elasticity.live.grace_seconds must be > 0")
+        if cfg.check_interval_steps < 1:
+            raise ConfigError(
+                "elasticity.live.check_interval_steps must be >= 1")
+        if not 0 < cfg.exit_code < 256:
+            raise ConfigError(
+                "elasticity.live.exit_code must be in 1..255")
+        return cfg
+
+
+@dataclass
 class GuardrailsDetectorConfig:
     """Anomaly-detector knobs (guardrails/detector.py)."""
 
@@ -965,7 +1049,35 @@ class DeepSpeedTPUConfig:
         # (reference runtime/config.py:679-733)
         self.elasticity = dict(d.get(C.ELASTICITY, {}))
         self.elasticity_enabled = bool(self.elasticity.get("enabled", False))
+        # Live elasticity (resilience/elastic.py): in-process shrink/grow
+        # + straggler eviction. Parsed here beside the ladder it rides;
+        # compatibility walls live in _validate.
+        self.elasticity_live = LiveElasticityConfig.from_dict(
+            self.elasticity.get(C.ELASTICITY_LIVE))
+        if self.elasticity_live.enabled:
+            # Walled HERE, before the batch triple resolves: a live config
+            # missing the ladder (or splitting the model over pipe) would
+            # otherwise die on a misleading batch-math error instead of
+            # the real cause. The remaining tier walls live in _validate.
+            if not self.elasticity_enabled:
+                raise ConfigError(
+                    "elasticity.live requires the elastic batch ladder "
+                    "(elasticity.enabled with max_train_batch_size/"
+                    "micro_batch_sizes): the in-process world change picks "
+                    "its new (world, micro, gas) from the ladder so the "
+                    "global batch — and convergence — never changes")
+            if (self.mesh.pipe > 1
+                    or int(dict(d.get(C.PIPELINE, {})).get("stages", 1)) > 1):
+                raise ConfigError(
+                    "elasticity.live cannot compose with pipeline "
+                    "parallelism: the pipe engine shards the MODEL over "
+                    "the pipe axis — losing a slice loses layers, not "
+                    "data-parallel replicas; use the plain engine")
         if self.elasticity_enabled:
+            # The ladder solver must not see the live sub-block as an
+            # unknown elasticity key (ElasticityConfig ignores extras, but
+            # elastic_config_hash canonicalises only the batch-math keys —
+            # live knobs are deliberately NOT convergence-relevant).
             self._apply_elasticity(d)
 
         # --- batch triple ----------------------------------------------------------
@@ -1186,6 +1298,32 @@ class DeepSpeedTPUConfig:
                     "the device by host transfer, not a mesh all-gather "
                     "— there is no wire hop for qwZ to quantize; use a "
                     "device-resident optimizer tier")
+        if self.elasticity_live.enabled:
+            # Live elasticity rebuilds the mesh + step functions in-process
+            # from gathered host state; the tiers below own their own state
+            # layout or wire protocol and cannot be resharded behind their
+            # backs — fail at parse with the real cause. (The ladder and
+            # pipeline walls fire earlier, in __init__, before the batch
+            # triple can mask them.)
+            if self.zero_config.zeropp.active:
+                raise ConfigError(
+                    "elasticity.live cannot compose with "
+                    "zero_optimization.zeropp yet: the explicit param "
+                    "gather plan bakes the mesh into its wire layout — "
+                    "drop zeropp or disable elasticity.live")
+            if (self.zero_config.offload_param.enabled
+                    or self.zero_config.offload_optimizer.enabled):
+                raise ConfigError(
+                    "elasticity.live cannot compose with the offload "
+                    "tiers: host-resident master/param state is laid out "
+                    "per-partition and the in-process reshard path "
+                    "(install_state_arrays) only re-places device state")
+            if str(self.optimizer_name or "").startswith("onebit"):
+                raise ConfigError(
+                    "elasticity.live cannot compose with 1-bit "
+                    "optimizers: the error-compensated compressed-"
+                    "momentum buffers are rank-local and do not survive "
+                    "a world change")
         if (self.telemetry.memory.enabled and self.guardrails.watchdog.enabled
                 and self.telemetry.memory.oom_exit_code
                 == self.guardrails.watchdog.exit_code):

@@ -361,6 +361,46 @@ QUANTIZE_TRAINING = "quantize_training"
 # Elasticity
 #############################################
 ELASTICITY = "elasticity"
+# Live elasticity (resilience/elastic.py; docs/RESILIENCE.md "Live
+# elasticity"): in-process shrink on a preemption advance warning,
+# step-boundary rejoin, and goodput-driven straggler eviction. Rides the
+# elasticity block (`elasticity.live`); default OFF — disabled means no
+# signal handlers, zero extra syncs, bit-identical lowered step.
+ELASTICITY_LIVE = "live"
+ELASTICITY_LIVE_ENABLED = "enabled"
+ELASTICITY_LIVE_ENABLED_DEFAULT = False
+# Preemption advance-warning grace window: the platform sends SIGTERM
+# this many seconds before pulling the slice; the coordinator must drain
+# + reshard inside it (GCE preemptible TPUs give 30s; tests use less).
+ELASTICITY_LIVE_GRACE = "grace_seconds"
+ELASTICITY_LIVE_GRACE_DEFAULT = 30.0
+# Step cadence at which the coordinator polls the rejoin rendezvous file
+# (one os.path check per poll — rejoin admission happens at the next
+# snapshot boundary, not mid-step).
+ELASTICITY_LIVE_CHECK_INTERVAL = "check_interval_steps"
+ELASTICITY_LIVE_CHECK_INTERVAL_DEFAULT = 10
+# Straggler eviction (the PR-6 Supervisor.straggler_hosts loop closed):
+# a persistent straggler is evicted only when the goodput cost model says
+# projected_gain = straggler_sec_rate x horizon_steps exceeds
+# min_gain_factor x measured reshard cost.
+ELASTICITY_LIVE_EVICTION = "eviction"
+ELASTICITY_LIVE_EVICTION_ENABLED = "enabled"
+ELASTICITY_LIVE_EVICTION_ENABLED_DEFAULT = False
+ELASTICITY_LIVE_EVICTION_HORIZON = "horizon_steps"
+ELASTICITY_LIVE_EVICTION_HORIZON_DEFAULT = 1000
+ELASTICITY_LIVE_EVICTION_MIN_GAIN = "min_gain_factor"
+ELASTICITY_LIVE_EVICTION_MIN_GAIN_DEFAULT = 2.0
+# Reshard cost assumed before the first measured in-process reshard
+# (afterwards the measured elastic/reshard_sec wins).
+ELASTICITY_LIVE_EVICTION_ASSUMED_RESHARD = "assumed_reshard_sec"
+ELASTICITY_LIVE_EVICTION_ASSUMED_RESHARD_DEFAULT = 60.0
+# Exit code when the coordinator received the advance warning but could
+# not stay up (no surviving capacity / no valid elastic world): the
+# supervisor classifies it `preemption_warned` — distinct from rc -15
+# (plain preemption: the process died without handling the warning).
+# Distinct from 113 (watchdog) and 114 (oom) by design.
+ELASTICITY_LIVE_EXIT_CODE = "exit_code"
+ELASTIC_PREEMPT_EXIT_CODE_DEFAULT = 115
 
 #############################################
 # Offload / async IO

@@ -11,6 +11,7 @@ from deepspeed_tpu.elasticity.elasticity import (
     ensure_immutable_elastic_config,
     highly_composite_numbers,
     pick_preferred_world,
+    world_change_plan,
 )
 
 # Reference exposes errors under deepspeed.elasticity.config as well.
@@ -21,5 +22,5 @@ __all__ = [
     "ElasticityIncompatibleWorldSize", "compute_elastic_config",
     "elastic_config_hash", "elasticity_enabled",
     "ensure_immutable_elastic_config", "highly_composite_numbers",
-    "pick_preferred_world", "config",
+    "pick_preferred_world", "world_change_plan", "config",
 ]

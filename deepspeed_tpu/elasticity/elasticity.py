@@ -229,6 +229,24 @@ def pick_preferred_world(ds_config: Dict, available_chips: int,
     return max(fitting)
 
 
+def world_change_plan(ds_config: Dict, available_chips: int,
+                      target_version: str = __version__
+                      ) -> Tuple[int, int, int]:
+    """``(world, micro_batch, gas)`` for an in-process world change
+    (resilience/elastic.py): the largest valid elastic world size fitting
+    ``available_chips`` plus the micro-batch / grad-accumulation split the
+    ladder prescribes for it. The final train batch is a property of the
+    ladder, not of the world size, so every rung this returns preserves
+    the global batch — and therefore the convergence trajectory — across
+    shrink *and* rejoin. Raises :class:`ElasticityIncompatibleWorldSize`
+    when no rung fits the surviving capacity (the coordinator then drains
+    to disk and exits with the distinct preemption-warned rc)."""
+    world = pick_preferred_world(ds_config, available_chips, target_version)
+    final_batch, _, micro = compute_elastic_config(
+        ds_config, target_version, world_size=world)
+    return world, micro, final_batch // (micro * world)
+
+
 def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict) -> None:
     """Cross-check the runtime elastic config against the one the resource
     scheduler used (env ``DEEPSPEED_ELASTICITY_CONFIG``); they must agree on

@@ -73,6 +73,13 @@ def parse_args(args=None):
                              "deterministic OOM is a config bug). Set "
                              "this when the ds-config overrides "
                              "telemetry.memory.oom_exit_code; default 114")
+    parser.add_argument("--warned_rc", type=int, default=None,
+                        help="Exit code treated as a handled preemption "
+                             "advance warning (live elasticity drained "
+                             "but no capacity survived; cause="
+                             "preemption_warned, restarted normally). Set "
+                             "this when the ds-config overrides "
+                             "elasticity.live.exit_code; default 115")
     parser.add_argument("--run_dir", type=str, default=None,
                         help="Goodput run dir (the job's telemetry.dir): "
                              "with --auto_resume, each attempt's run "
@@ -277,10 +284,13 @@ def main(args=None):
             from deepspeed_tpu.resilience import Supervisor
             immediate = ({args.watchdog_rc} if args.watchdog_rc is not None
                          else None)   # None -> supervisor default (113)
+            warned = ({args.warned_rc} if args.warned_rc is not None
+                      else None)      # None -> supervisor default (115)
             sys.exit(Supervisor(cmd, max_restarts=args.max_restarts,
                                 max_backoff=args.max_backoff,
                                 immediate_restart_rcs=immediate,
                                 oom_rcs={oom_rc},
+                                warned_rcs=warned,
                                 run_dir=args.run_dir,
                                 env=env).run())
         result = subprocess.run(cmd, env={**os.environ, **env})
@@ -332,14 +342,17 @@ def main(args=None):
         (best-effort — accounting must never break the recovery loop)."""
         if not args.run_dir:
             return
-        from deepspeed_tpu.config.constants import \
-            GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT
+        from deepspeed_tpu.config.constants import (
+            ELASTIC_PREEMPT_EXIT_CODE_DEFAULT,
+            GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT)
         watchdog = (args.watchdog_rc,) if args.watchdog_rc is not None \
             else (GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT,)
+        warned = (args.warned_rc,) if args.warned_rc is not None \
+            else (ELASTIC_PREEMPT_EXIT_CODE_DEFAULT,)
         try:
             finalize_attempt_manifests(args.run_dir, attempt, rc_,
                                        classify_exit(rc_, watchdog,
-                                                     (oom_rc,)),
+                                                     (oom_rc,), warned),
                                        start_wall, time.time())
         except Exception as e:  # noqa: BLE001
             logger.warning("goodput manifest finalize failed: %s", e)

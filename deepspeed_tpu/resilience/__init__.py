@@ -7,6 +7,15 @@ from deepspeed_tpu.resilience.checkpoint import (AsyncCheckpointManager,
                                                  install_state_arrays,
                                                  list_checkpoints, restore,
                                                  snapshot_engine)
+from deepspeed_tpu.resilience.elastic import (ELASTIC_METRIC_TAGS,
+                                              PREEMPT_SLICE_ENV,
+                                              ElasticCoordinator,
+                                              LiveElasticityError,
+                                              build_elastic,
+                                              clear_rejoin_request,
+                                              evaluate_eviction,
+                                              read_rejoin_request,
+                                              request_rejoin)
 from deepspeed_tpu.resilience.fault import (FAULT_PLAN_ENV,
                                             RESUME_ATTEMPT_ENV, FaultPlan,
                                             corrupt_one_shard)
@@ -18,4 +27,7 @@ __all__ = [
     "install_state_arrays", "list_checkpoints", "restore", "snapshot_engine",
     "FaultPlan", "corrupt_one_shard", "FAULT_PLAN_ENV", "RESUME_ATTEMPT_ENV",
     "Supervisor", "supervise_main", "ELASTIC_WORLD_ENV",
+    "ELASTIC_METRIC_TAGS", "PREEMPT_SLICE_ENV", "ElasticCoordinator",
+    "LiveElasticityError", "build_elastic", "clear_rejoin_request",
+    "evaluate_eviction", "read_rejoin_request", "request_rejoin",
 ]

@@ -130,6 +130,12 @@ def snapshot_engine(engine, client_state: Optional[Dict] = None) -> _Snapshot:
         "step": int(engine.global_steps),
         "micro_steps": int(engine.micro_steps),
         "elastic_hash": getattr(engine, "elastic_hash", ""),
+        # Live-elasticity world-change epoch (resilience/elastic.py):
+        # which incarnation of the mesh wrote this checkpoint — 0 until a
+        # world change happens. Informational (restore reshards onto
+        # whatever mesh the restoring engine runs), but post-mortem tools
+        # can line checkpoints up against the manifest's world timeline.
+        "elastic_epoch": int(getattr(engine, "elastic_epoch", 0)),
         "world_size": int(engine.mesh.size),
         "dp_world_size": int(engine.dp_size),
         "zero_stage": int(engine.config.zero_config.stage),

@@ -1,0 +1,619 @@
+"""Live elasticity — in-process shrink/grow on preemption, step-boundary
+rejoin, and goodput-driven straggler eviction (docs/RESILIENCE.md "Live
+elasticity").
+
+The supervisor tier (PR 1) already survives a preemption — by paying a
+full cold restart: process death, interpreter + jax re-import, engine
+reconstruction, checkpoint deserialize, reshard. The goodput reports say
+``init_restore`` dominates that bill. This module removes it for the case
+that actually dominates preemptible fleets — the *advance-warned* slice
+preemption:
+
+- **shrink** — the platform's advance warning (SIGTERM inside a
+  configurable grace window) is *caught*, not obeyed: at the next step
+  boundary the coordinator drains in-flight work, pulls the newest
+  verdict-clean state (live engine state when the guardrails verdict is
+  clean, else the guardrails ``SnapshotRing``, else the newest on-disk
+  resilience checkpoint), asks the elastic ladder for the largest valid
+  world fitting the surviving chips
+  (:func:`deepspeed_tpu.elasticity.world_change_plan` — the global batch
+  is a ladder property, so convergence never changes), rebuilds the mesh
+  and jitted step functions, and re-places the gathered host state through
+  the existing ``install_state_arrays`` reshard path. Same pid, no
+  ``init_restore``, no supervisor round-trip.
+- **rejoin** — a returning slice is re-admitted at the next snapshot
+  boundary through a small supervisor-coordinated rendezvous: the
+  returning side writes a rejoin request file (host, chips,
+  ``elastic_config_hash``) into the run dir; the coordinator polls it at
+  ``check_interval_steps`` cadence, re-checks the hash (two worlds may
+  differ in chips but must agree on batch math), and grows back. The
+  world-change epoch is stamped into the goodput run manifest and every
+  resilience checkpoint manifest, so post-mortem tools can line attempts
+  up against world changes.
+- **evict** — the fleet layer's persistent-straggler verdicts
+  (telemetry/fleet.py, PR 6 ``Supervisor.straggler_hosts``) finally close
+  their loop: a straggler is evicted only when the goodput cost model
+  (:func:`evaluate_eviction` — measured ``straggler_sec`` rate × horizon
+  vs. measured reshard cost) says shrinking wins. Every decision — taken
+  or declined — is logged as an ``elastic/*`` instant naming the host and
+  the evidence, and recorded in the run manifest.
+
+Zero-overhead contract (the house rule): ``elasticity.live`` defaults off
+and :func:`build_elastic` then returns ``None`` — no signal handler is
+installed, the engine's step-boundary hook is one attribute check, and
+the lowered step program is bit-identical to an elasticity-less config
+(tests/test_elastic.py pins all three).
+"""
+
+import contextlib
+import json
+import os
+import signal
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+# Test/simulation seam: names the victim slice of the NEXT advance
+# warning. On a real deployment each host knows its own slice id — the
+# warning lands on the doomed hosts — but the single-process CPU
+# reproduction receives its own SIGTERM and must be told which slice the
+# platform is taking.
+PREEMPT_SLICE_ENV = "DSTPU_PREEMPT_SLICE"
+
+# The rendezvous file a returning slice's supervisor writes into the run
+# dir; the coordinator admits it at the next snapshot boundary.
+REJOIN_REQUEST_FILE = "elastic_rejoin.json"
+
+# Every metric tag this module can emit — gauges plus the decision
+# instants — pinned against docs/OBSERVABILITY.md in BOTH directions by
+# tests/test_doc_lint.py, like GOODPUT_METRIC_TAGS.
+ELASTIC_METRIC_TAGS = frozenset({
+    "elastic/world_size",
+    "elastic/reshards",
+    "elastic/reshard_sec",
+    "elastic/evictions",
+    # decision/event instants (trace markers, same namespace)
+    "elastic/preempt_warned",
+    "elastic/shrink",
+    "elastic/rejoin",
+    "elastic/rejoin_refused",
+    "elastic/evict",
+})
+
+
+class LiveElasticityError(RuntimeError):
+    """The coordinator could not complete a world change."""
+
+
+# ---------------------------------------------------------------------------
+# Eviction cost model
+# ---------------------------------------------------------------------------
+
+def evaluate_eviction(lost_sec_per_step: float,
+                      horizon_steps: int,
+                      reshard_cost_sec: float,
+                      min_gain_factor: float = 2.0) -> Dict[str, Any]:
+    """The goodput cost model behind every eviction decision: keeping the
+    straggler costs ``lost_sec_per_step`` on every future step (the fleet
+    runs at the slowest host's pace — telemetry/fleet.py books the same
+    number as ``goodput/straggler_sec``); evicting costs one reshard.
+    Evict iff the projected loss over ``horizon_steps`` exceeds
+    ``min_gain_factor`` × the reshard cost — the factor absorbs the
+    throughput the smaller world gives up and the chance the straggler
+    recovers on its own. Pure arithmetic, unit-tested against synthetic
+    fleets."""
+    projected = max(0.0, float(lost_sec_per_step)) * max(int(horizon_steps), 0)
+    cost = max(0.0, float(reshard_cost_sec))
+    return {
+        "lost_sec_per_step": float(lost_sec_per_step),
+        "horizon_steps": int(horizon_steps),
+        "projected_gain_sec": projected,
+        "reshard_cost_sec": cost,
+        "min_gain_factor": float(min_gain_factor),
+        "evict": projected > cost * float(min_gain_factor),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rejoin rendezvous (file-based: the supervisor and the coordinator share
+# the run dir; nothing else is assumed about the control plane)
+# ---------------------------------------------------------------------------
+
+def request_rejoin(run_dir: str, host: str, chips: int,
+                   elastic_config_hash: str = "") -> str:
+    """Written by the returning slice's supervisor: ask the running job to
+    re-admit ``chips`` chips at its next snapshot boundary."""
+    path = os.path.join(run_dir, REJOIN_REQUEST_FILE)
+    os.makedirs(run_dir, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"host": host, "chips": int(chips),
+                   "elastic_config_hash": elastic_config_hash,
+                   "requested_wall": time.time()}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_rejoin_request(run_dir: str) -> Optional[Dict[str, Any]]:
+    path = os.path.join(run_dir, REJOIN_REQUEST_FILE)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def clear_rejoin_request(run_dir: str) -> None:
+    with contextlib.suppress(OSError):
+        os.remove(os.path.join(run_dir, REJOIN_REQUEST_FILE))
+
+
+# ---------------------------------------------------------------------------
+# The coordinator
+# ---------------------------------------------------------------------------
+
+class ElasticCoordinator:
+    """Per-engine live-elasticity driver.
+
+    The engine owns exactly one call site — :meth:`step_boundary` after
+    every committed optimizer step (one attribute check when nothing is
+    pending) — plus :meth:`install`/:meth:`close` around its lifetime.
+    Everything expensive (drain, gather, rebuild) happens only on an
+    actual world change.
+    """
+
+    def __init__(self, engine, lcfg, run_dir: Optional[str] = None):
+        self.engine = engine
+        self.cfg = lcfg
+        self.run_dir = run_dir
+        self.epoch = 0
+        # Slice-major device inventory of the FULL mesh, captured at
+        # construction: _full_slice_devices[k] is slice k's device list.
+        mesh = engine.mesh
+        from deepspeed_tpu.parallel.mesh import DCN_AXIS
+        n_slices = mesh.shape.get(DCN_AXIS, 1)
+        dev_array = mesh.devices
+        per_slice = dev_array.reshape(n_slices, -1)
+        self._full_slice_devices: List[List[Any]] = [
+            list(per_slice[k].ravel()) for k in range(n_slices)]
+        self._full_slices = n_slices
+        self._lost_slices: set = set()
+        self.world_size = int(mesh.size)
+        self._preempt_pending = False
+        self._warned_at: Optional[float] = None
+        self._victim_slice: Optional[int] = None
+        self._prev_handler = None
+        self._installed = False
+        self.reshards = 0
+        self.evictions = 0
+        self.last_reshard_sec: Optional[float] = None
+        self._shrink_step_attempt: Optional[int] = None
+        self.eviction_decisions: List[Dict[str, Any]] = []
+        # Deployment seam: maps a fleet-flagged straggler host to the
+        # slice to evict. None => decisions are logged/stamped but no
+        # shrink is executed (the supervisor-level restart policy still
+        # acts on them).
+        self.host_slice_fn = None
+        self._evict_decided: set = set()
+        self._grow_pending = False
+
+    # -- lifecycle -------------------------------------------------------
+    def install(self) -> "ElasticCoordinator":
+        """Install the SIGTERM advance-warning handler. Only called when
+        ``elasticity.live`` is enabled — a disabled config never touches
+        signal dispositions (the zero-overhead contract)."""
+        try:
+            self._prev_handler = signal.signal(signal.SIGTERM,
+                                               self._on_sigterm)
+            self._installed = True
+        except ValueError:
+            # Not the main thread: the platform warning cannot reach a
+            # python handler here anyway.
+            logger.warning(
+                "live elasticity: cannot install SIGTERM handler off the "
+                "main thread — advance warnings will kill the process "
+                "(the supervisor cold-restart path still applies)")
+        return self
+
+    def close(self) -> None:
+        if self._installed:
+            with contextlib.suppress(ValueError):
+                signal.signal(signal.SIGTERM,
+                              self._prev_handler or signal.SIG_DFL)
+            self._installed = False
+
+    # -- the advance warning --------------------------------------------
+    def _on_sigterm(self, signum, frame) -> None:
+        now = time.monotonic()
+        if self._preempt_pending:
+            # Second SIGTERM while one warning is still pending: the
+            # platform is out of patience — restore the previous
+            # disposition and die like an unwarned preemption.
+            logger.warning("live elasticity: second SIGTERM before the "
+                           "pending shrink completed — giving up")
+            signal.signal(signal.SIGTERM,
+                          self._prev_handler or signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        self._preempt_pending = True
+        self._warned_at = now
+        self._victim_slice = self._resolve_victim()
+        logger.warning(
+            "live elasticity: preemption advance warning caught (SIGTERM; "
+            "grace %.1fs, victim slice %s) — will drain and shrink "
+            "in-process at the next step boundary",
+            self.cfg.grace_seconds, self._victim_slice)
+        tel = self.engine.telemetry
+        if tel is not None and tel.enabled:
+            tel.instant("elastic/preempt_warned",
+                        slice=self._victim_slice,
+                        grace_seconds=self.cfg.grace_seconds)
+
+    def _resolve_victim(self) -> int:
+        env = os.environ.get(PREEMPT_SLICE_ENV)
+        if env is not None and env != "":
+            return int(env)
+        fp = getattr(self.engine, "fault_plan", None)
+        if fp is not None and fp.slice_preempt_slice is not None:
+            return int(fp.slice_preempt_slice)
+        surviving = [k for k in range(self._full_slices)
+                     if k not in self._lost_slices]
+        return surviving[-1] if surviving else 0
+
+    # -- the per-step hook ----------------------------------------------
+    def step_boundary(self, engine) -> None:
+        """Called by the engine after every committed step. Steady state:
+        a couple of attribute checks; world changes happen only here —
+        between steps, never mid-collective."""
+        if self._preempt_pending:
+            self._preempt_pending = False
+            grace_left = (self.cfg.grace_seconds
+                          - (time.monotonic() - (self._warned_at or 0.0)))
+            if grace_left <= 0:
+                logger.warning(
+                    "live elasticity: grace window (%.1fs) already "
+                    "elapsed before the step boundary — shrinking anyway "
+                    "(the platform may kill us mid-reshard)",
+                    self.cfg.grace_seconds)
+            self.shrink(self._victim_slice, cause="preemption",
+                        grace_left=max(0.0, grace_left))
+            return
+        if self._lost_slices:
+            fp = getattr(engine, "fault_plan", None)
+            if self._grow_pending or (
+                    fp is not None and fp.should_rejoin(
+                        engine.step_attempts, self._shrink_step_attempt)):
+                self._grow_pending = False
+                self.grow(cause="rejoin")
+                return
+            if self._rendezvous_due(engine):
+                return  # grow (or refusal) already handled inside
+        if self.cfg.eviction.enabled and engine.fleet is not None:
+            self.maybe_evict(engine)
+
+    def _rendezvous_due(self, engine) -> bool:
+        """Poll the rejoin request file at the snapshot-boundary cadence;
+        admit (grow) on a hash-matching request, refuse loudly otherwise.
+        Returns True when a request was consumed either way."""
+        if not self.run_dir:
+            return False
+        if engine.global_steps % self.cfg.check_interval_steps != 0:
+            return False
+        req = read_rejoin_request(self.run_dir)
+        if req is None:
+            return False
+        want = getattr(engine, "elastic_hash", "")
+        got = req.get("elastic_config_hash", "")
+        if want and want != got:
+            # A missing/empty hash is refused too: the writer is an
+            # EXTERNAL supervisor, and admitting an unverified slice
+            # would silently waive the batch-math contract the doc
+            # promises is re-checked.
+            logger.warning(
+                "live elasticity: rejoin request from %s REFUSED — "
+                "elastic config hash %r does not match the running "
+                "ladder %s (different batch math would change the "
+                "trajectory mid-run; the request must carry the "
+                "ladder's elastic_config_hash)",
+                req.get("host"), got[:12], want[:12])
+            tel = engine.telemetry
+            if tel is not None and tel.enabled:
+                tel.instant("elastic/rejoin_refused", host=req.get("host"),
+                            theirs=got[:12], ours=want[:12])
+            clear_rejoin_request(self.run_dir)
+            return True
+        clear_rejoin_request(self.run_dir)
+        self.grow(cause="rejoin", host=req.get("host"))
+        return True
+
+    # -- shrink / grow ---------------------------------------------------
+    def request_shrink(self, victim_slice: Optional[int] = None) -> None:
+        """Programmatic shrink request (platform integrations, chaos
+        soaks): behaves exactly like a caught advance warning — the world
+        change lands at the next step boundary."""
+        self._preempt_pending = True
+        self._warned_at = time.monotonic()
+        self._victim_slice = (victim_slice if victim_slice is not None
+                              else self._resolve_victim())
+
+    def request_rejoin_now(self) -> None:
+        """Programmatic rejoin request: grow back at the next step
+        boundary (the file-based rendezvous is the cross-process path)."""
+        self._grow_pending = True
+
+    def shrink(self, victim_slice: Optional[int], *,
+               cause: str = "preemption", grace_left: float = 0.0,
+               host: Optional[str] = None) -> None:
+        victim = (int(victim_slice) if victim_slice is not None
+                  else self._resolve_victim())
+        self._lost_slices.add(victim)
+        surviving = [k for k in range(self._full_slices)
+                     if k not in self._lost_slices]
+        chips = sum(len(self._full_slice_devices[k]) for k in surviving)
+        if chips == 0:
+            self._drain_and_exit(
+                f"live elasticity: slice {victim} preempted and no "
+                "capacity survives — draining to disk and exiting with "
+                "the preemption-warned rc")
+        try:
+            self._reshard(surviving, cause=cause, detail={
+                "slice": victim, "grace_left_sec": round(grace_left, 3),
+                **({"host": host} if host else {})})
+        except Exception as e:  # noqa: BLE001 — no valid world / rebuild
+            # failure: the warned preemption still ends the process, but
+            # with state drained and the distinct rc.
+            self._drain_and_exit(
+                f"live elasticity: in-process shrink after losing slice "
+                f"{victim} failed ({e}) — draining to disk and exiting "
+                "with the preemption-warned rc")
+
+    def grow(self, *, cause: str = "rejoin",
+             host: Optional[str] = None) -> None:
+        returned = sorted(self._lost_slices)
+        surviving = list(range(self._full_slices))
+        try:
+            self._reshard(surviving, cause=cause, detail={
+                "returned_slices": returned,
+                **({"host": host} if host else {})})
+        except Exception as e:  # noqa: BLE001 — a failed rejoin must not
+            # poison the training loop OR the coordinator's world view:
+            # the shrunken world keeps training, the slices stay marked
+            # lost (a later rejoin request can retry), and the refusal is
+            # loud.
+            logger.error(
+                "live elasticity: rejoin of slices %s FAILED (%s) — "
+                "continuing at the current world %d; a new rejoin "
+                "request can retry", returned, e, self.world_size)
+            tel = self.engine.telemetry
+            if tel is not None and tel.enabled:
+                tel.instant("elastic/rejoin_refused",
+                            returned_slices=returned, error=str(e))
+            return
+        self._lost_slices.clear()
+
+    def _reshard(self, surviving_slices: List[int], *, cause: str,
+                 detail: Dict[str, Any]) -> None:
+        """The one world-change implementation shrink/grow/evict share:
+        drain → clean-state gather → ladder solve → engine rebuild →
+        telemetry + manifest stamps."""
+        import jax
+
+        from deepspeed_tpu.elasticity import world_change_plan
+
+        engine = self.engine
+        t0 = time.monotonic()
+        gp = engine.goodput
+        measure = (gp.measure("elastic_reshard") if gp is not None
+                   else contextlib.nullcontext())
+        gr = engine.guardrails
+        if gr is not None and gr.watchdog is not None:
+            # A reshard (recompile included) is not a hung step; the
+            # deadline must not convert it into a watchdog kill — same
+            # rule as rollback recovery.
+            gr.watchdog.suspend()
+        with measure:
+            # Drain: every dispatched program referencing the old mesh
+            # must complete before its buffers are gathered/re-placed.
+            jax.block_until_ready(engine.state)
+            arrays, meta, source = self._clean_state(engine)
+            flat_devices = [d for k in surviving_slices
+                            for d in self._full_slice_devices[k]]
+            ds_config = {"elasticity": dict(engine.config.elasticity)}
+            world, micro, gas = world_change_plan(ds_config,
+                                                  len(flat_devices))
+            slices, devices = self._solve_slices(surviving_slices, world)
+            engine._elastic_rebuild(devices=devices, slices=slices,
+                                    micro_batch=micro, gas=gas,
+                                    arrays=arrays, meta=meta)
+        dt = time.monotonic() - t0
+        self.reshards += 1
+        self.epoch += 1
+        engine.elastic_epoch = self.epoch
+        self.last_reshard_sec = dt
+        self.world_size = world
+        self._shrink_step_attempt = (None if cause == "rejoin"
+                                     else engine.step_attempts)
+        logger.warning(
+            "live elasticity: %s reshard complete in %.3fs — world %d "
+            "(slices %s, micro %d, gas %d, state from %s, epoch %d)",
+            cause, dt, world, slices, micro, gas, source, self.epoch)
+        self._emit(engine, cause=cause, detail={**detail,
+                                                "state_source": source,
+                                                "reshard_sec": round(dt, 4)})
+        if gp is not None:
+            gp.note_world_change({
+                "epoch": self.epoch, "step": int(engine.global_steps),
+                "world_size": world, "cause": cause,
+                "reshard_sec": round(dt, 4), **detail})
+            gp.write_manifest()
+
+    def _solve_slices(self, surviving_slices: List[int],
+                      world: int) -> Tuple[int, List[Any]]:
+        """Fit ``world`` chips onto whole surviving slices: the largest
+        slice count whose per-slice share divides evenly (a slice is the
+        DCN failure/billing domain — never split one across the ladder's
+        rung). Falls back to a single flat slice of the first ``world``
+        devices when nothing divides (degenerate ladders)."""
+        cfg = self.engine.config
+        fixed = (cfg.mesh.model * cfg.mesh.pipe * cfg.mesh.sequence
+                 * cfg.mesh.expert)
+        for s in range(len(surviving_slices), 0, -1):
+            if world % (s * fixed):
+                continue
+            per_slice = world // s
+            take = surviving_slices[:s]
+            if all(len(self._full_slice_devices[k]) >= per_slice
+                   for k in take):
+                devices = [d for k in take
+                           for d in self._full_slice_devices[k][:per_slice]]
+                return s, devices
+        flat = [d for k in surviving_slices
+                for d in self._full_slice_devices[k]]
+        return 1, flat[:world]
+
+    def _clean_state(self, engine) -> Tuple[Dict[str, Any], Dict[str, Any],
+                                            str]:
+        """The newest VERDICT-CLEAN host state: the live engine state when
+        the last guardrails verdict (if any) was not a spike; else the
+        guardrails SnapshotRing's newest entry; else the newest complete
+        on-disk resilience checkpoint. Raises when nothing clean exists —
+        resharding poisoned state would just carry the poison to the new
+        world."""
+        from deepspeed_tpu.resilience.checkpoint import (find_restorable,
+                                                         snapshot_engine)
+
+        gr = engine.guardrails
+        suspect = (gr is not None and gr.last_verdict is not None
+                   and bool(gr.last_verdict))
+        if not suspect:
+            snap = snapshot_engine(engine)
+            return dict(snap.arrays), snap.meta, "live"
+        if gr.ring is not None and gr.ring.newest() is not None:
+            snap = gr.ring.newest()
+            logger.warning(
+                "live elasticity: last verdict was a spike — resharding "
+                "from the snapshot ring (step %s), not live state",
+                snap.meta.get("step"))
+            return dict(snap.arrays), snap.meta, "ring"
+        rcfg = getattr(engine.config, "resilience", None)
+        if rcfg is not None and rcfg.enabled:
+            found = find_restorable(rcfg.checkpoint.dir)
+            if found is not None:
+                _, manifest, arrays, _ = found
+                logger.warning(
+                    "live elasticity: resharding from on-disk checkpoint "
+                    "step %s (no clean in-memory state)",
+                    manifest.get("step"))
+                return arrays, manifest, "disk"
+        raise LiveElasticityError(
+            "no verdict-clean state to reshard from (live state is "
+            "spike-suspect, the snapshot ring is empty and no complete "
+            "on-disk checkpoint exists)")
+
+    def _drain_and_exit(self, message: str) -> None:
+        engine = self.engine
+        logger.error(message)
+        with contextlib.suppress(Exception):
+            if engine.ckpt_manager is not None:
+                engine.save_checkpoint_async()
+                engine.ckpt_manager.wait()
+        if engine.goodput is not None:
+            engine.goodput.finalize(exit_rc=self.cfg.exit_code)
+        os._exit(self.cfg.exit_code)
+
+    # -- eviction --------------------------------------------------------
+    def maybe_evict(self, engine) -> Optional[Dict[str, Any]]:
+        """Close the straggler loop: when the fleet layer marks a host
+        persistent, run the goodput cost model; evict its slice when the
+        model approves AND a host→slice mapping exists. Each host gets
+        ONE decision per run (persistent verdicts repeat every flush —
+        re-deciding would spam the manifest)."""
+        fleet = engine.fleet
+        verdict = getattr(fleet, "last_verdict", None)
+        if not verdict or not verdict.get("persistent"):
+            return None
+        host = verdict["host"]
+        if host in self._evict_decided:
+            return None
+        self._evict_decided.add(host)
+        reshard_cost = (self.last_reshard_sec
+                        if self.last_reshard_sec is not None
+                        else self.cfg.eviction.assumed_reshard_sec)
+        decision = evaluate_eviction(
+            verdict.get("lost_sec_per_step", 0.0),
+            self.cfg.eviction.horizon_steps,
+            reshard_cost,
+            self.cfg.eviction.min_gain_factor)
+        decision.update(host=host, zscore=round(verdict.get("zscore", 0.0), 3),
+                        step=int(engine.global_steps),
+                        reshard_cost_measured=self.last_reshard_sec
+                        is not None)
+        self.eviction_decisions.append(decision)
+        tel = engine.telemetry
+        if tel is not None and tel.enabled:
+            tel.instant("elastic/evict", **{
+                k: decision[k] for k in ("host", "zscore", "evict",
+                                         "projected_gain_sec",
+                                         "reshard_cost_sec", "step")})
+        if engine.goodput is not None:
+            engine.goodput.note_eviction(decision)
+        if not decision["evict"]:
+            logger.warning(
+                "live elasticity: straggler %s (z=%.2f) NOT evicted — "
+                "projected gain %.1fs over %d steps < %.1fx reshard cost "
+                "%.1fs", host, decision["zscore"],
+                decision["projected_gain_sec"], decision["horizon_steps"],
+                decision["min_gain_factor"], decision["reshard_cost_sec"])
+            return decision
+        slice_id = (self.host_slice_fn(host)
+                    if self.host_slice_fn is not None else None)
+        if slice_id is None:
+            logger.warning(
+                "live elasticity: eviction of straggler %s approved "
+                "(gain %.1fs > %.1fx cost %.1fs) but no host→slice "
+                "mapping is configured — decision recorded for the "
+                "supervisor restart policy", host,
+                decision["projected_gain_sec"],
+                decision["min_gain_factor"], decision["reshard_cost_sec"])
+            return decision
+        logger.warning(
+            "live elasticity: EVICTING straggler %s (slice %d, z=%.2f): "
+            "projected gain %.1fs over %d steps > %.1fx reshard cost "
+            "%.1fs", host, slice_id, decision["zscore"],
+            decision["projected_gain_sec"], decision["horizon_steps"],
+            decision["min_gain_factor"], decision["reshard_cost_sec"])
+        self.evictions += 1
+        self.shrink(slice_id, cause="eviction", host=host)
+        return decision
+
+    # -- telemetry -------------------------------------------------------
+    def _emit(self, engine, *, cause: str, detail: Dict[str, Any]) -> None:
+        tel = engine.telemetry
+        if tel is None or not tel.enabled:
+            return
+        step = int(engine.global_steps)
+        reg = tel.registry
+        reg.gauge("elastic/world_size").set(self.world_size, step=step,
+                                            epoch=self.epoch)
+        reg.gauge("elastic/reshards").set(self.reshards, step=step)
+        reg.gauge("elastic/reshard_sec").set(
+            self.last_reshard_sec or 0.0, step=step, cause=cause)
+        reg.gauge("elastic/evictions").set(self.evictions, step=step)
+        name = ("elastic/shrink" if cause in ("preemption", "eviction")
+                else "elastic/rejoin")
+        tel.instant(name, cause=cause, world_size=self.world_size,
+                    epoch=self.epoch, step=step, **detail)
+        tel.flush()
+
+
+def build_elastic(engine) -> Optional[ElasticCoordinator]:
+    """``None`` unless ``elasticity.live`` is enabled — the engine's hook
+    gates on ``is None`` and NO signal handler is installed (the
+    zero-overhead contract, same shape as guardrails/goodput/fleet)."""
+    lcfg = getattr(engine.config, "elasticity_live", None)
+    if lcfg is None or not lcfg.enabled:
+        return None
+    tcfg = engine.config.telemetry
+    run_dir = tcfg.dir if getattr(tcfg, "enabled", False) else None
+    return ElasticCoordinator(engine, lcfg, run_dir=run_dir).install()

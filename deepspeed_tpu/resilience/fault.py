@@ -22,7 +22,18 @@ faults a run must survive:
 - ``hang_at_step`` / ``hang_seconds`` — the step stalls mid-flight (the
   deadlocked-collective shape), exercising the guardrails step watchdog's
   diagnostics dump + distinct-rc exit and the supervisor's immediate
-  restart.
+  restart;
+- ``slice_preempt_at_step`` / ``slice_preempt_slice`` /
+  ``preempt_grace_seconds`` — the multi-slice preemption ADVANCE WARNING:
+  SIGTERM delivered to self at step-attempt k *without* resetting the
+  handler, so the live-elasticity coordinator (resilience/elastic.py) can
+  catch it and shrink in-process within the grace window (contrast
+  ``preempt_at_step``, which restores SIG_DFL first — the no-warning
+  death shape). ``slice_preempt_slice`` names the victim slice (default:
+  the highest surviving index);
+- ``rejoin_after_steps`` — the preempted slice "returns" this many step
+  attempts after the shrink, exercising the step-boundary rejoin path
+  deterministically.
 
 The numeric/hang faults are keyed on **step attempts** (a monotonic count
 of dispatched steps) rather than ``global_steps``: a guardrails rollback
@@ -64,6 +75,10 @@ class FaultPlan:
     nan_loss_steps: int = 1
     hang_at_step: Optional[int] = None
     hang_seconds: float = 3600.0
+    slice_preempt_at_step: Optional[int] = None
+    slice_preempt_slice: Optional[int] = None
+    preempt_grace_seconds: float = 30.0
+    rejoin_after_steps: Optional[int] = None
     max_attempt: int = 0
 
     def __post_init__(self):
@@ -73,6 +88,10 @@ class FaultPlan:
             raise ValueError("nan_loss_steps must be >= 1")
         if self.hang_seconds <= 0:
             raise ValueError("hang_seconds must be > 0")
+        if self.preempt_grace_seconds <= 0:
+            raise ValueError("preempt_grace_seconds must be > 0")
+        if self.rejoin_after_steps is not None and self.rejoin_after_steps < 1:
+            raise ValueError("rejoin_after_steps must be >= 1")
         self._io_errors_left = int(self.ckpt_write_errors)
 
     # ------------------------------------------------------------------
@@ -166,6 +185,36 @@ class FaultPlan:
                        "global step %d", global_step)
         signal.signal(signal.SIGTERM, signal.SIG_DFL)
         os.kill(os.getpid(), signal.SIGTERM)
+
+    # -- multi-slice chaos (live elasticity; resilience/elastic.py) -----
+    def should_slice_preempt(self, step_attempt: int) -> bool:
+        """Keyed on step ATTEMPTS like hang/nan: the elastic shrink does
+        not rewind the counter, so the warning fires exactly once."""
+        return (self.slice_preempt_at_step is not None
+                and step_attempt == self.slice_preempt_at_step)
+
+    def slice_preempt(self) -> None:
+        """Deliver the preemption ADVANCE WARNING: SIGTERM to self with
+        whatever handler is installed — the live-elasticity coordinator's,
+        when elasticity.live is on. The real platform would hard-kill
+        ``preempt_grace_seconds`` later; the deterministic injection
+        leaves enforcement to the coordinator's own grace bookkeeping."""
+        logger.warning(
+            "FaultPlan: injecting slice-preemption advance warning "
+            "(SIGTERM, grace %.1fs, victim slice %s)",
+            self.preempt_grace_seconds,
+            self.slice_preempt_slice
+            if self.slice_preempt_slice is not None else "<last>")
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def should_rejoin(self, step_attempt: int,
+                      shrink_step_attempt: Optional[int]) -> bool:
+        """The preempted slice returns ``rejoin_after_steps`` step
+        attempts after the shrink the warning caused."""
+        return (self.rejoin_after_steps is not None
+                and shrink_step_attempt is not None
+                and step_attempt >= shrink_step_attempt
+                + self.rejoin_after_steps)
 
 
 def corrupt_one_shard(ckpt_path: str, manifest: Dict[str, Any]) -> str:

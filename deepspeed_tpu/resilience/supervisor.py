@@ -23,6 +23,7 @@ import time
 from typing import Callable, Dict, Iterable, List, Optional
 
 from deepspeed_tpu.config.constants import (
+    ELASTIC_PREEMPT_EXIT_CODE_DEFAULT,
     GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT, MEMORY_OOM_EXIT_CODE_DEFAULT)
 from deepspeed_tpu.guardrails.retry import backoff_delay
 from deepspeed_tpu.resilience.fault import RESUME_ATTEMPT_ENV
@@ -61,6 +62,7 @@ class Supervisor:
                  jitter: float = 0.25,
                  immediate_restart_rcs: Optional[Iterable[int]] = None,
                  oom_rcs: Optional[Iterable[int]] = None,
+                 warned_rcs: Optional[Iterable[int]] = None,
                  ckpt_dir: Optional[str] = None,
                  run_dir: Optional[str] = None,
                  available_worlds: Optional[Callable[[int], int]] = None):
@@ -77,6 +79,13 @@ class Supervisor:
             else (GUARDRAILS_WATCHDOG_EXIT_CODE_DEFAULT,))
         self.oom_rcs = set(oom_rcs if oom_rcs is not None
                            else (MEMORY_OOM_EXIT_CODE_DEFAULT,))
+        # The live-elasticity coordinator's distinct rc (resilience/
+        # elastic.py): the advance warning WAS handled (state drained)
+        # but no surviving capacity fit a valid world. Classified
+        # `preemption_warned` — restarted like any preemption, but the
+        # manifests record that elasticity did its half of the job.
+        self.warned_rcs = set(warned_rcs if warned_rcs is not None
+                              else (ELASTIC_PREEMPT_EXIT_CODE_DEFAULT,))
         self.ckpt_dir = ckpt_dir
         # Goodput run dir (the child's telemetry.dir): when set, each
         # attempt's run manifest gets its exit rc / restart cause stamped
@@ -93,6 +102,10 @@ class Supervisor:
         # the logs today, and the input the elasticity policy (ROADMAP
         # item 4) will use to pick which slice to drop on reshard.
         self.straggler_hosts: List[str] = []
+        # Goodput-costed eviction decisions stamped into the run
+        # manifests after each attempt (resilience/elastic.py cost
+        # model; rendered by tools/fleet_report.py).
+        self.eviction_decisions: List[Dict] = []
         self.metrics = None
         if ckpt_dir:
             from deepspeed_tpu.resilience.checkpoint import METRICS_FILE
@@ -124,28 +137,73 @@ class Supervisor:
         try:
             finalize_attempt_manifests(
                 self.run_dir, attempt, rc,
-                classify_exit(rc, self.immediate_restart_rcs, self.oom_rcs),
+                classify_exit(rc, self.immediate_restart_rcs, self.oom_rcs,
+                              self.warned_rcs),
                 start_wall, time.time())
         except Exception as e:  # noqa: BLE001
             logger.warning("supervisor: manifest finalize failed: %s", e)
 
-    def _note_stragglers(self) -> None:
+    def _note_stragglers(self, attempt: int = 0) -> None:
         """Surface persistent-straggler verdicts from the fleet breakdown
-        file alongside the restart decision. Best-effort."""
+        file alongside the restart decision, and stamp a goodput-costed
+        eviction decision (host, z-score, projected gain vs. restart
+        cost) into the attempt's run manifests for tools/fleet_report.py.
+        Best-effort."""
         if not self.run_dir:
             return
         try:
-            from deepspeed_tpu.telemetry.fleet import \
-                read_persistent_stragglers
-            hosts = read_persistent_stragglers(self.run_dir)
+            from deepspeed_tpu.telemetry.fleet import read_straggler_evidence
+            evidence = read_straggler_evidence(self.run_dir)
         except Exception:  # noqa: BLE001
             return
-        if hosts:
-            self.straggler_hosts = hosts
-            logger.warning(
-                "supervisor: fleet telemetry marked persistent straggler "
-                "host(s) %s — throughput is paced by them; an elastic "
-                "restart excluding them may recover goodput", hosts)
+        hosts = sorted(h for h, e in evidence.items() if e["persistent"])
+        if not hosts:
+            return
+        self.straggler_hosts = hosts
+        logger.warning(
+            "supervisor: fleet telemetry marked persistent straggler "
+            "host(s) %s — throughput is paced by them; an elastic "
+            "restart excluding them may recover goodput", hosts)
+        # Supervisor-level cost model: the alternative to keeping the
+        # straggler is a RESTART at a smaller world, so the cost side is
+        # the attempt's measured in-process reshard time when one
+        # happened, else the cold-restart proxy (the live-elasticity
+        # default). The gain side is the fleet-measured cumulative
+        # straggler_sec — time already lost, projected to repeat.
+        try:
+            from deepspeed_tpu.config.config import LiveEvictionConfig
+            from deepspeed_tpu.resilience.elastic import evaluate_eviction
+            from deepspeed_tpu.telemetry.goodput import \
+                stamp_eviction_decisions
+            defaults = LiveEvictionConfig()
+            decisions = []
+            for host in hosts:
+                e = evidence[host]
+                decision = evaluate_eviction(
+                    # The breakdown's windowed per-step excess — SAME
+                    # units the in-process coordinator feeds the model
+                    # (lost_sec is cumulative over flushed steps, not a
+                    # rate).
+                    e["lost_sec_per_step"],
+                    defaults.horizon_steps,
+                    defaults.assumed_reshard_sec,
+                    defaults.min_gain_factor)
+                decision.update(host=host, zscore=e.get("last_zscore"),
+                                step=None, source="supervisor",
+                                lost_sec_total=e["lost_sec"])
+                decisions.append(decision)
+                logger.warning(
+                    "supervisor: eviction decision for %s: %s (projected "
+                    "gain %.1fs vs %.1fx restart cost %.1fs)", host,
+                    "EVICT" if decision["evict"] else "keep",
+                    decision["projected_gain_sec"],
+                    decision["min_gain_factor"],
+                    decision["reshard_cost_sec"])
+            self.eviction_decisions = decisions
+            stamp_eviction_decisions(self.run_dir, attempt, decisions)
+        except Exception as e:  # noqa: BLE001 — accounting must never
+            # take down the recovery loop
+            logger.warning("supervisor: eviction stamping failed: %s", e)
 
     def run(self) -> int:
         """Run until clean exit or restart budget exhausted; returns the
@@ -167,7 +225,7 @@ class Supervisor:
                 raise
             self.exit_codes.append(rc)
             self._finalize_attempt(attempt, rc, start_wall)
-            self._note_stragglers()
+            self._note_stragglers(attempt)
             if rc == 0:
                 if self.metrics is not None:
                     self.metrics.add_scalar(
@@ -245,6 +303,12 @@ def supervise_main(argv: Optional[List[str]] = None) -> int:
                          "(repeatable); default: the memory observatory rc "
                          "114. Set when the ds-config overrides "
                          "telemetry.memory.oom_exit_code")
+    ap.add_argument("--warned_rc", type=int, action="append", default=None,
+                    help="Exit code classified cause=preemption_warned "
+                         "(live elasticity caught the grace-window SIGTERM "
+                         "but no capacity survived; restarted normally). "
+                         "Default: rc 115. Set when the ds-config overrides "
+                         "elasticity.live.exit_code")
     ap.add_argument("--checkpoint_dir", type=str, default=None)
     ap.add_argument("--run_dir", type=str, default=None,
                     help="Goodput run dir (the child's telemetry.dir): "
@@ -260,6 +324,7 @@ def supervise_main(argv: Optional[List[str]] = None) -> int:
                       backoff=args.backoff, max_backoff=args.max_backoff,
                       immediate_restart_rcs=args.immediate_rc,
                       oom_rcs=args.oom_rc,
+                      warned_rcs=args.warned_rc,
                       ckpt_dir=args.checkpoint_dir,
                       run_dir=args.run_dir).run()
 

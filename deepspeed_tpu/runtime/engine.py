@@ -202,6 +202,7 @@ class TPUEngine:
         self.param_specs = self.partitioner.param_specs(params, param_partition_specs)
         self.grad_specs = self.partitioner.grad_specs(params, param_partition_specs)
         self.opt_specs = self.partitioner.opt_state_specs(params, param_partition_specs)
+        self._custom_batch_spec = batch_spec is not None
         if batch_spec is not None:
             self.batch_spec = batch_spec
         elif self.dcn_size > 1:
@@ -325,6 +326,9 @@ class TPUEngine:
         from deepspeed_tpu.parallel.mesh import PIPE_AXIS
         self._comm_dtype = comm_dtype_from_config(
             config.communication_data_type)
+        # Stashed for the live-elasticity rebuild path, which re-resolves
+        # the sync strategy against the post-change mesh.
+        self._sparse_grads_handled = bool(sparse_gradients_handled)
         self._grad_sync_on, sync_reason = resolve_hierarchical(
             config.comm, self.mesh,
             needs_local_grads=getattr(self.optimizer, "needs_local_grads",
@@ -556,6 +560,33 @@ class TPUEngine:
         # injection (FaultPlan nan_loss/hang) keys on it so a rolled-back
         # window is not re-poisoned forever.
         self.step_attempts = 0
+        # --- live elasticity: in-process shrink/grow + straggler eviction --
+        # (resilience/elastic.py; docs/RESILIENCE.md "Live elasticity").
+        # build_elastic returns None for a disabled block — no SIGTERM
+        # handler installed, the step-boundary hook one attribute check,
+        # and the lowered step bit-identical (tests/test_elastic.py).
+        # World-change epoch: stamped into every checkpoint manifest and
+        # the goodput run manifest so post-mortem tooling can line
+        # attempts up against world changes.
+        self.elastic_epoch = 0
+        from deepspeed_tpu.resilience.elastic import build_elastic
+        if config.elasticity_live.enabled:
+            if self._offload_cfg.enabled:
+                # The explicit offload blocks are walled at config parse;
+                # the HOST-IMPLIED tier (optimizer.type "cpuadam" / any
+                # host_resident optimizer object) resolves only here.
+                raise ConfigError(
+                    "elasticity.live cannot compose with the host "
+                    "optimizer tier (offload_optimizer, or a host-"
+                    "resident optimizer such as 'cpuadam'): host master/"
+                    "moment state is laid out per-partition and the "
+                    "in-process reshard only re-places device state")
+            if getattr(self.optimizer, "needs_local_grads", False):
+                raise ConfigError(
+                    "elasticity.live cannot compose with 1-bit "
+                    "optimizers: rank-local error-feedback buffers do "
+                    "not survive a world change")
+        self.elastic = build_elastic(self)
         # Device-sync barriers in the timers are gated on wall_clock_breakdown:
         # a breakdown-off run must not pay a block_until_ready round-trip per
         # step just to feed timings nobody reads.
@@ -2246,9 +2277,22 @@ class TPUEngine:
         if (mgr is not None and not suspect
                 and self.global_steps % mgr.interval == 0):
             self.save_checkpoint_async()
-        if (self.fault_plan is not None
-                and self.fault_plan.should_preempt(self.global_steps)):
-            self.fault_plan.preempt(self.global_steps)
+        fp = self.fault_plan
+        if fp is not None and fp.should_preempt(self.global_steps):
+            fp.preempt(self.global_steps)
+        if fp is not None and fp.should_slice_preempt(self.step_attempts):
+            # The advance-warning shape: SIGTERM WITHOUT resetting the
+            # handler, so the live-elasticity coordinator (when enabled)
+            # catches it; without one the default disposition kills us —
+            # a plain preemption, exactly the contrast the chaos test
+            # wants reproducible.
+            fp.slice_preempt()
+        el = self.elastic
+        if el is not None:
+            # Step boundary: pending shrink (caught advance warning),
+            # rejoin rendezvous, or eviction check. One attribute check
+            # plus a couple of flag reads in steady state.
+            el.step_boundary(self)
 
     def register_client_state_fn(self, fn: Callable[[], Dict]) -> None:
         """Callable whose result rides every auto-checkpoint as
@@ -2310,6 +2354,118 @@ class TPUEngine:
             with self.goodput.measure("init_restore"):
                 return restore(self, rcfg.checkpoint.dir)
         return restore(self, rcfg.checkpoint.dir)
+
+    def _elastic_rebuild(self, *, devices, slices: int, micro_batch: int,
+                         gas: int, arrays: Dict[str, Any],
+                         meta: Dict[str, Any]) -> None:
+        """In-process elastic world change (resilience/elastic.py): rebuild
+        mesh → ZeRO placement → batch triple → state placement → jitted
+        step functions over ``devices``, then install the gathered host
+        ``arrays`` through the existing ``install_state_arrays`` reshard
+        path. No process restart, no ``init_restore`` — the coordinator
+        wraps the whole call in ONE goodput ``elastic_reshard`` measure.
+
+        Only the data-parallel fused tiers rebuild (config validation
+        walls off pipe/offload/1-bit/zeropp before an engine with live
+        elasticity can exist). Mutates the batch keys of ``self.config``
+        — the elastic ladder owns them by contract, and the step builders
+        read them at build time."""
+        from deepspeed_tpu.comm.grad_sync import resolve_hierarchical
+        from deepspeed_tpu.parallel.mesh import (DCN_AXIS, PIPE_AXIS,
+                                                 build_mesh,
+                                                 get_default_mesh)
+        from deepspeed_tpu.resilience.checkpoint import (_flatten_named,
+                                                         install_state_arrays)
+
+        cfg = self.config
+        # Host params template for the new placement, reconstructed from
+        # the gathered snapshot (full arrays — the reshard-by-construction
+        # property of the PR-1 checkpoint format).
+        named, params_def = _flatten_named(self.state.params)
+        missing = [n for n, _ in named if f"params.{n}" not in arrays]
+        if missing:
+            raise ConfigError(
+                f"elastic rebuild: snapshot lacks param leaves "
+                f"{missing[:5]} — was it written by a different model?")
+        params_host = jax.tree_util.tree_unflatten(
+            params_def, [np.asarray(arrays[f"params.{n}"])
+                         for n, _ in named])
+
+        old_mesh = self.mesh
+        mesh = build_mesh(data=-1, model=cfg.mesh.model, pipe=cfg.mesh.pipe,
+                          sequence=cfg.mesh.sequence, expert=cfg.mesh.expert,
+                          slices=slices, devices=list(devices))
+        self.mesh = mesh
+        self.dcn_size = mesh.shape.get(DCN_AXIS, 1)
+        self.dp_size = mesh.shape.get(DATA_AXIS, 1) * self.dcn_size
+        if get_default_mesh() is old_mesh:
+            # Keep the ambient mesh (mesh-needing attention ops) in step
+            # with the live engine, but never steal another engine's.
+            mesh_lib_set_default(mesh)
+        self.partitioner = ZeroPartitioner(mesh, cfg.zero_config)
+        self.param_specs = self.partitioner.param_specs(
+            params_host, self._base_specs)
+        self.grad_specs = self.partitioner.grad_specs(
+            params_host, self._base_specs)
+        self.opt_specs = self.partitioner.opt_state_specs(
+            params_host, self._base_specs)
+        if not self._custom_batch_spec:
+            self.batch_spec = (PartitionSpec((DCN_AXIS, DATA_AXIS))
+                               if self.dcn_size > 1
+                               else PartitionSpec(DATA_AXIS))
+
+        # The elastic ladder owns the batch triple (config._apply_
+        # elasticity wrote the originals the same way): same global batch,
+        # re-split for the new world.
+        cfg.gradient_accumulation_steps = int(gas)
+        cfg.train_micro_batch_size_per_gpu = int(micro_batch)
+        cfg.train_batch_size = int(micro_batch) * int(gas) * self.dp_size
+        self.gradient_accumulation_steps = int(gas)
+        self.train_micro_batch_size_per_gpu = int(micro_batch)
+        self.train_batch_size = cfg.train_batch_size
+        self.tput_timer.batch_size = self.train_batch_size
+
+        # Re-resolve the grad-sync strategy: a shrink to one slice has no
+        # DCN axis left for the hierarchical sync to serve (and a rejoin
+        # brings it back).
+        self._grad_sync_on, sync_reason = resolve_hierarchical(
+            cfg.comm, mesh, needs_local_grads=False,
+            sparse_gradients=(cfg.sparse_gradients_enabled
+                              or self._sparse_grads_handled),
+            pipe_stages=mesh.shape.get(PIPE_AXIS, 1))
+        self.grad_sync_plan = None
+        log_dist(f"elastic rebuild: hierarchical grad sync "
+                 f"{'on' if self._grad_sync_on else 'off'} ({sync_reason})",
+                 ranks=[0])
+
+        # Fresh placement on the new mesh (moments re-initialised as
+        # templates only), then the snapshot's values land on it through
+        # the one shared host-arrays→engine path.
+        self.state = self._init_state(params_host, rng_seed=0)
+        install_state_arrays(
+            self, arrays, step=int(meta["step"]),
+            micro_steps=int(meta["micro_steps"]),
+            lr_scheduler_state=meta.get("lr_scheduler"))
+        self._build_step_fns()
+
+        # The rebuilt step functions MUST recompile — that is the point —
+        # so the detector's next trace is the expected one-time compile,
+        # not a loud retrace warning operators would learn to ignore; the
+        # MFU cost analysis re-arms for the new world's FLOPs/chips.
+        for fn in ("engine.train_step", "engine.eval_step",
+                   "engine.micro_step", "engine.global_norm"):
+            self.telemetry.recompile.forget(fn)
+        if self.goodput is not None:
+            self.goodput.reset_flops()
+        if self.memory is not None:
+            # Ledger + capacity projections are per-mesh; re-derive them
+            # (pure host arithmetic over shapes/specs).
+            self.memory.on_engine_init(self)
+        log_dist(
+            f"elastic rebuild: world={mesh.size} mesh={dict(mesh.shape)} "
+            f"micro={micro_batch} gas={gas} global_batch="
+            f"{self.train_batch_size} at step {self.global_steps}",
+            ranks=[0])
 
     def _snapshot_state(self) -> TrainState:
         """The state tree a resilience snapshot serialises — swapped tiers

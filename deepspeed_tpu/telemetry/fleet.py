@@ -231,6 +231,14 @@ class FleetAggregator:
         self._window: collections.deque = collections.deque(
             maxlen=int(fcfg.window))
         self.straggler_counts: Dict[str, int] = {}
+        # Cumulative fleet-level seconds lost to each host's skew (the
+        # sum of verdict lost_sec) — the eviction cost model's evidence
+        # (resilience/elastic.py) and a breakdown-file column.
+        self.straggler_lost: Dict[str, float] = {}
+        # Newest windowed per-step excess per host — the rate input of
+        # the eviction cost model (same units the in-process coordinator
+        # reads from verdict["lost_sec_per_step"]).
+        self.straggler_rate: Dict[str, float] = {}
         self.last_verdict: Optional[Dict[str, Any]] = None
         self._prev: Optional[Dict[str, float]] = None
         # sync'd-span step-time feed (sum, count) since the last flush —
@@ -409,7 +417,14 @@ class FleetAggregator:
                    # fleet-level time lost to this straggler.
                    "lost_sec": float(max(0.0, step_times[worst]
                                          - np.median(step_times))
-                                     * max(steps_delta, 1.0))}
+                                     * max(steps_delta, 1.0)),
+                   # The windowed per-step excess — the eviction cost
+                   # model's rate input (lost seconds per future step if
+                   # the straggler stays).
+                   "lost_sec_per_step": float(max(0.0, means[worst] - med))}
+        self.straggler_lost[host] = (self.straggler_lost.get(host, 0.0)
+                                     + verdict["lost_sec"])
+        self.straggler_rate[host] = verdict["lost_sec_per_step"]
         self.last_verdict = verdict
         return verdict
 
@@ -446,6 +461,8 @@ class FleetAggregator:
             "stragglers": {
                 h: {"count": c,
                     "persistent": c >= int(self.cfg.persist),
+                    "lost_sec": self.straggler_lost.get(h, 0.0),
+                    "lost_sec_per_step": self.straggler_rate.get(h, 0.0),
                     "last_zscore": (self.last_verdict["zscore"]
                                     if self.last_verdict is not None
                                     and self.last_verdict["host"] == h
@@ -474,6 +491,35 @@ def build_fleet(tcfg, telemetry=None, goodput=None) -> \
         return None
     return FleetAggregator(tcfg.fleet, run_dir=tcfg.dir,
                            telemetry=telemetry, goodput=goodput)
+
+
+def read_straggler_evidence(run_dir: str) -> Dict[str, Dict[str, Any]]:
+    """Per-host straggler evidence from the fleet breakdown file(s):
+    ``{host: {count, persistent, lost_sec, last_zscore}}`` — what the
+    supervisor's eviction decision (resilience/elastic.py cost model)
+    and the in-process coordinator read. Best-effort: unreadable files
+    are skipped; the newest file's entry wins per host."""
+    import glob as _glob
+    import json as _json
+
+    out: Dict[str, Dict[str, Any]] = {}
+    for path in sorted(_glob.glob(os.path.join(run_dir,
+                                               "fleet_breakdown*.json"))):
+        try:
+            with open(path) as f:
+                doc = _json.load(f)
+        except (OSError, ValueError):
+            continue
+        for host, info in (doc.get("stragglers") or {}).items():
+            out[host] = {
+                "count": int(info.get("count") or 0),
+                "persistent": bool(info.get("persistent")),
+                "lost_sec": float(info.get("lost_sec") or 0.0),
+                "lost_sec_per_step": float(
+                    info.get("lost_sec_per_step") or 0.0),
+                "last_zscore": info.get("last_zscore"),
+            }
+    return out
 
 
 def read_persistent_stragglers(run_dir: str) -> List[str]:

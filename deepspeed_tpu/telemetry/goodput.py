@@ -86,6 +86,12 @@ CATEGORIES = (
     "data_stall",
     "recompile",
     "init_restore",
+    # In-process elastic world change (resilience/elastic.py): drain +
+    # state gather + mesh/step-fn rebuild + reshard. Mark-based like every
+    # other category (the coordinator wraps the whole reshard in ONE
+    # measure), so the exact-partition invariant holds and reshard time
+    # never leaks into idle_other.
+    "elastic_reshard",
     "idle_other",
 )
 
@@ -199,6 +205,11 @@ class GoodputAccountant:
         self._lock = threading.Lock()
         self._totals: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
         self._aux: Dict[str, float] = {}
+        # Live-elasticity bookkeeping (resilience/elastic.py): world-change
+        # timeline entries + eviction decisions, persisted in the run
+        # manifest so goodput_report/fleet_report can render them.
+        self._elastic: list = []
+        self._evictions: list = []
         now_mono, now_wall = clock(), wall_clock()
         # Interpreter start-up happened before this object existed; when the
         # spawner stamped the start wall time, backdate the attempt to it
@@ -280,6 +291,30 @@ class GoodputAccountant:
                 self._step_count += 1
                 self._last_step_dt = dt
         return dt
+
+    def note_world_change(self, entry: Dict[str, Any]) -> None:
+        """Append one world-change timeline entry (epoch, step, world,
+        cause, reshard seconds) — rendered by tools/goodput_report.py as
+        the per-attempt world-change timeline row."""
+        with self._lock:
+            self._elastic.append(dict(entry))
+
+    def note_eviction(self, entry: Dict[str, Any]) -> None:
+        """Record one straggler-eviction decision (host, z-score,
+        projected gain, verdict) for the run manifest —
+        tools/fleet_report.py renders these beside the straggler table."""
+        with self._lock:
+            self._evictions.append(dict(entry))
+
+    def reset_flops(self) -> None:
+        """Re-arm the once-per-compiled-step cost analysis — called after
+        an in-process elastic reshard, whose rebuilt step function has a
+        different FLOPs/chips profile (engine/mfu must not keep the old
+        world's denominator)."""
+        with self._lock:
+            self._flops_attempted = False
+            self._flops_per_step = None
+            self._bytes_per_step = None
 
     def note_aux(self, name: str, seconds: float) -> None:
         """Cumulative auxiliary gauge (``goodput/<name>``) that is NOT part
@@ -435,6 +470,8 @@ class GoodputAccountant:
             "mfu": self.mfu(),
             "n_chips": self._n_chips,
             "flops_per_step": self._flops_per_step,
+            "elastic": [dict(e) for e in self._elastic],
+            "eviction_decisions": [dict(e) for e in self._evictions],
         }
 
     def write_manifest(self, exit_rc: Optional[int] = None,
@@ -485,7 +522,8 @@ def build_goodput(tcfg, telemetry=None, cfg_hash: str = "",
 # Supervisor-side manifest finalisation
 # ---------------------------------------------------------------------------
 
-def classify_exit(rc: int, immediate_restart_rcs=(), oom_rcs=()) -> str:
+def classify_exit(rc: int, immediate_restart_rcs=(), oom_rcs=(),
+                  warned_rcs=()) -> str:
     """Human-readable restart cause from a child exit code."""
     if rc == 0:
         return "clean"
@@ -495,9 +533,52 @@ def classify_exit(rc: int, immediate_restart_rcs=(), oom_rcs=()) -> str:
         return "oom"
     if rc in set(immediate_restart_rcs or ()):
         return "watchdog"
+    if rc in set(warned_rcs or ()):
+        # The live-elasticity coordinator's distinct rc (resilience/
+        # elastic.py): the grace-window SIGTERM arrived and WAS handled —
+        # state drained to disk — but no surviving capacity fit a valid
+        # elastic world, so the process exited deliberately. Distinct
+        # from "preemption" (rc -15: the warning was never caught).
+        return "preemption_warned"
     if rc < 0 or rc in (128 + 15, 128 + 9):  # signal deaths (Popen: -sig)
         return "preemption"
     return "crash"
+
+
+def stamp_eviction_decisions(run_dir: str, attempt: int,
+                             decisions: list) -> int:
+    """Supervisor-side: stamp straggler-eviction decisions (host,
+    z-score, projected gain, verdict) onto every host manifest of one
+    attempt — the post-mortem record tools/fleet_report.py renders. The
+    child's own in-process decisions (GoodputAccountant.note_eviction)
+    already live in the manifest; the supervisor's entries merge after
+    them, deduplicated by (host, step). Returns manifests touched."""
+    if not decisions:
+        return 0
+    prefix = f"{MANIFEST_PREFIX}a{attempt:04d}."
+    touched = 0
+    try:
+        entries = sorted(os.listdir(run_dir)) if os.path.isdir(run_dir) else []
+    except OSError:
+        entries = []
+    for name in entries:
+        if not (name.startswith(prefix) and name.endswith(".json")):
+            continue
+        path = os.path.join(run_dir, name)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        have = doc.get("eviction_decisions") or []
+        seen = {(d.get("host"), d.get("step")) for d in have}
+        for d in decisions:
+            if (d.get("host"), d.get("step")) not in seen:
+                have.append(dict(d))
+        doc["eviction_decisions"] = have
+        _atomic_write_json(path, doc)
+        touched += 1
+    return touched
 
 
 def finalize_attempt_manifests(run_dir: str, attempt: int, rc: int,

@@ -102,6 +102,16 @@ class RecompileDetector:
         self._report(fn_name, prev, sig, step)
         return "retrace"
 
+    def forget(self, fn_name: str) -> None:
+        """Drop every fingerprint for ``fn_name`` so its next trace counts
+        as the expected one-time compile, not a retrace. For EXPECTED
+        recompilations only — today that is the in-process elastic world
+        change (resilience/elastic.py), whose rebuilt step functions MUST
+        recompile; warning about them would train operators to ignore the
+        detector."""
+        with self._lock:
+            self._seen.pop(fn_name, None)
+
     # ------------------------------------------------------------------
     def _report(self, fn_name: str, prev: Optional[Tuple], sig: Tuple,
                 step: Optional[int]) -> None:

@@ -17,6 +17,7 @@ import os
 import re
 
 from deepspeed_tpu.comm.grad_sync import COMM_PARAM_METRIC_TAGS
+from deepspeed_tpu.resilience.elastic import ELASTIC_METRIC_TAGS
 from deepspeed_tpu.serving.engine import SERVING_METRIC_TAGS
 from deepspeed_tpu.telemetry.devicetime import DEVICETIME_METRIC_TAGS
 from deepspeed_tpu.telemetry.fleet import FLEET_METRIC_TAGS
@@ -38,6 +39,8 @@ _SERVING_TOKEN_RE = re.compile(r"serving/[A-Za-z_]+")
 _DEVICETIME_TOKEN_RE = re.compile(r"devicetime/[A-Za-z_]+")
 _NUMERICS_TOKEN_RE = re.compile(r"numerics/[A-Za-z_]+")
 _COMM_PARAMS_TOKEN_RE = re.compile(r"comm/[A-Za-z_]+_params")
+# \b so "elasticity/" (the package path) never false-positives
+_ELASTIC_TOKEN_RE = re.compile(r"\belastic/[A-Za-z_]+")
 
 
 def _iter_py_files():
@@ -189,6 +192,32 @@ class TestDocDrift:
         assert emitted, "the scan must see the param-hop emissions"
         assert emitted <= COMM_PARAM_METRIC_TAGS, (
             emitted - COMM_PARAM_METRIC_TAGS)
+
+    def test_elastic_tags_documented_and_vice_versa(self):
+        """The live-elasticity surface (resilience/elastic.py) is pinned
+        in BOTH directions like goodput/fleet: every tag the coordinator
+        can emit — the elastic/* gauges plus the decision/event instants
+        — must be in the doc, and every elastic/* token the doc names
+        must be one the code emits."""
+        doc = _doc_text()
+        undocumented = sorted(t for t in ELASTIC_METRIC_TAGS
+                              if t not in doc)
+        assert not undocumented, undocumented
+        doc_tokens = set(_ELASTIC_TOKEN_RE.findall(doc))
+        phantom = sorted(t for t in doc_tokens
+                         if t not in ELASTIC_METRIC_TAGS)
+        assert not phantom, (
+            f"docs/OBSERVABILITY.md names elastic tags the code never "
+            f"emits: {phantom}")
+        # every literal elastic/* emission in the tree is a declared tag
+        emitted = {t for _, _, t in _emitted_literals()
+                   if t.startswith("elastic/")}
+        assert emitted, "the scan must see the elastic gauge emissions"
+        assert emitted <= ELASTIC_METRIC_TAGS, (
+            emitted - ELASTIC_METRIC_TAGS)
+        # the reshard wall-clock category rides the goodput enforcement
+        assert "goodput/elastic_reshard_sec" in GOODPUT_METRIC_TAGS
+        assert "goodput/elastic_reshard_sec" in doc
 
     def test_numerics_tags_documented_and_vice_versa(self):
         """The numerics surface (telemetry/numerics.py) is pinned in

@@ -235,6 +235,17 @@ def merge_fleet(run_dir: str) -> Dict[str, Any]:
     stragglers = sorted(h for h, s in straggler_info.items())
     persistent = sorted(h for h, s in straggler_info.items()
                         if s.get("persistent"))
+    # Eviction decisions (resilience/elastic.py cost model): union over
+    # every attempt's manifests — the engine's in-process decisions plus
+    # the supervisor's post-mortem stamps — deduplicated.
+    evictions: List[Dict[str, Any]] = []
+    seen_ev = set()
+    for m in manifests:
+        for d in (m.get("eviction_decisions") or []):
+            key = (d.get("host"), d.get("step"), d.get("source"))
+            if key not in seen_ev:
+                seen_ev.add(key)
+                evictions.append(d)
     return {
         "run_dir": os.path.abspath(run_dir),
         "hosts": rows,
@@ -242,6 +253,7 @@ def merge_fleet(run_dir: str) -> Dict[str, Any]:
         "fleet_stats": (breakdown or {}).get("stats"),
         "stragglers": stragglers,
         "persistent_stragglers": persistent,
+        "eviction_decisions": evictions,
         "breakdown_step": (breakdown or {}).get("step"),
         "trace_files": {h or "local": p
                         for h, p in found["traces"].items()},
@@ -336,6 +348,18 @@ def render(report: Dict[str, Any]) -> str:
         out.append("")
         out.append("persistent straggler(s): "
                    + ", ".join(report["persistent_stragglers"]))
+    if report.get("eviction_decisions"):
+        out.append("")
+        out.append("eviction decisions (goodput cost model, "
+                   "resilience/elastic.py):")
+        for d in report["eviction_decisions"]:
+            out.append(
+                f"  [{d.get('source', 'engine')}] host={d.get('host')} "
+                f"z={d.get('zscore')} "
+                f"gain={float(d.get('projected_gain_sec') or 0.0):.1f}s "
+                f"cost={float(d.get('reshard_cost_sec') or 0.0):.1f}s "
+                f"(x{d.get('min_gain_factor')}) -> "
+                f"{'EVICT' if d.get('evict') else 'keep'}")
     profile = report.get("profile")
     if profile:
         out.append("")
@@ -372,6 +396,13 @@ def _selftest() -> int:
                 "categories": {"productive_step": prod, "data_stall": 4.0,
                                "recompile": 8.0, "init_restore": 5.0},
                 "aux": {"exposed_comm_sec": 6.0},
+                # Supervisor-stamped eviction decision (identical on every
+                # host manifest — the report must dedup it to one row).
+                "eviction_decisions": [
+                    {"host": "hostB", "zscore": 4.2, "evict": True,
+                     "projected_gain_sec": 300.0, "reshard_cost_sec": 60.0,
+                     "min_gain_factor": 2.0, "step": None,
+                     "source": "supervisor"}],
                 "first_step": 1, "steps_committed": 30,
                 "mean_step_time_sec": prod / 30, "mfu": mfu, "n_chips": 4})
         for host, frac in (("hostA", 0.12), ("hostB", 0.15)):
@@ -428,6 +459,10 @@ def _selftest() -> int:
              if e.get("ph") == "M" and e.get("name") == "process_name"}
     assert {"hostA", "hostB"} <= names
     assert "hostB" in text and "persistent" in text
+    # eviction decisions: deduped to one row (both host manifests carried
+    # the same supervisor stamp) and rendered with the evidence
+    assert len(report["eviction_decisions"]) == 1
+    assert "eviction decisions" in text and "EVICT" in text
     print(text)
     print("\nselftest ok")
     return 0

@@ -51,6 +51,7 @@ CATEGORIES = (
     "data_stall",
     "recompile",
     "init_restore",
+    "elastic_reshard",
     "idle_other",
 )
 
@@ -169,6 +170,23 @@ def _merge_attempt(manifests: List[Dict[str, Any]],
     rcs = [m.get("exit_rc") for m in manifests if m.get("exit_rc") is not None]
     causes = [m.get("restart_cause") for m in manifests
               if m.get("restart_cause")]
+    # Live-elasticity world-change timeline (resilience/elastic.py):
+    # union across host manifests, deduplicated by epoch, step-ordered —
+    # rendered as a per-attempt timeline row so reshard time is
+    # attributable (its seconds live in the elastic_reshard category).
+    elastic: Dict[int, Dict[str, Any]] = {}
+    for m in manifests:
+        for entry in (m.get("elastic") or []):
+            elastic.setdefault(int(entry.get("epoch", 0)), entry)
+    evictions: List[Dict[str, Any]] = []
+    seen_ev = set()
+    for m in manifests:
+        for entry in (m.get("eviction_decisions") or []):
+            key = (entry.get("host"), entry.get("step"),
+                   entry.get("source"))
+            if key not in seen_ev:
+                seen_ev.add(key)
+                evictions.append(entry)
     return {
         "attempt": attempt,
         "hosts": sorted({m.get("host", "?") for m in manifests}),
@@ -187,6 +205,8 @@ def _merge_attempt(manifests: List[Dict[str, Any]],
         "mfu": mfu,
         "exit_rc": rcs[0] if rcs else None,
         "restart_cause": causes[0] if causes else None,
+        "elastic": [elastic[e] for e in sorted(elastic)],
+        "eviction_decisions": evictions,
     }
 
 
@@ -321,7 +341,7 @@ def render(report: Dict[str, Any]) -> str:
             out.append(f"  {name:<18} {sec:>12.3f} {sec / wall:>7.1%}")
     out.append("")
     out.append("restarts:")
-    hdr = (f"  {'attempt':>7} {'rc':>5} {'cause':<11} {'steps':>6} "
+    hdr = (f"  {'attempt':>7} {'rc':>5} {'cause':<17} {'steps':>6} "
            f"{'wall s':>9} {'goodput':>8} {'mfu':>7}")
     out.append(hdr)
     out.append("  " + "-" * (len(hdr) - 2))
@@ -331,9 +351,27 @@ def render(report: Dict[str, Any]) -> str:
         m = f"{a['mfu']:.1%}" if a["mfu"] is not None else "n/a"
         rc = a["exit_rc"] if a["exit_rc"] is not None else "?"
         out.append(f"  {a['attempt']:>7} {rc!s:>5} "
-                   f"{(a['restart_cause'] or '?'):<11} "
+                   f"{(a['restart_cause'] or '?'):<17} "
                    f"{a['steps_committed']:>6} {a['wall_sec']:>9.1f} "
                    f"{gp:>7.1%} {m:>7}")
+        # Live-elasticity timeline: one row per attempt that changed
+        # worlds, so in-process reshards are visible next to the restart
+        # they avoided (their seconds live in elastic_reshard above,
+        # never idle_other).
+        for e in (a.get("elastic") or []):
+            out.append(
+                f"          world -> {e.get('world_size')} "
+                f"({e.get('cause', '?')} @ step {e.get('step', '?')}, "
+                f"epoch {e.get('epoch', '?')}, "
+                f"{float(e.get('reshard_sec') or 0.0):.2f}s in-process "
+                f"reshard)")
+        for d in (a.get("eviction_decisions") or []):
+            out.append(
+                f"          eviction[{d.get('source', 'engine')}] "
+                f"host={d.get('host')} z={d.get('zscore')} "
+                f"gain={float(d.get('projected_gain_sec') or 0.0):.1f}s "
+                f"cost={float(d.get('reshard_cost_sec') or 0.0):.1f}s -> "
+                f"{'EVICT' if d.get('evict') else 'keep'}")
     return "\n".join(out)
 
 
@@ -363,8 +401,18 @@ def _selftest() -> int:
             "exit_rc": -15, "restart_cause": "preemption",
             "categories": {"productive_step": 40.0, "data_stall": 4.0,
                            "recompile": 8.0, "ckpt_snapshot": 2.0,
-                           "init_restore": 5.0, "idle_other": 1.0},
+                           "init_restore": 5.0, "elastic_reshard": 0.5,
+                           "idle_other": 0.5},
             "aux": {"exposed_comm_sec": 6.0, "straggler_sec": 2.0},
+            # Live elasticity: one in-process shrink at step 20 (its 0.5s
+            # lives in elastic_reshard above, NOT idle_other) and one
+            # declined eviction decision.
+            "elastic": [{"epoch": 1, "step": 20, "world_size": 4,
+                         "cause": "preemption", "reshard_sec": 0.5}],
+            "eviction_decisions": [
+                {"host": "hostB", "zscore": 4.2, "evict": False,
+                 "projected_gain_sec": 30.0, "reshard_cost_sec": 60.0,
+                 "min_gain_factor": 2.0, "step": 25, "source": "engine"}],
             "first_step": 1, "steps_committed": 30,
             "mean_step_time_sec": 1.0, "mfu": 0.30, "n_chips": 8})
         # Attempt 1: spawned 2 s later (backoff), restored step 25,
@@ -412,6 +460,15 @@ def _selftest() -> int:
     assert report["sub_attributions"]["exposed_comm_sec"] == 13.0
     assert report["sub_attributions"]["straggler_sec"] == 2.0
     assert "sub-attributions" in text and "exposed_comm_sec" in text
+    # Live elasticity: reshard seconds land in their own category (never
+    # idle_other) and the world-change timeline + eviction decision rows
+    # render under the attempt that produced them.
+    assert report["categories"]["elastic_reshard"] == 0.5
+    a0 = report["attempts"][0]
+    assert a0["elastic"][0]["world_size"] == 4
+    assert a0["eviction_decisions"][0]["host"] == "hostB"
+    assert "world -> 4 (preemption @ step 20" in text
+    assert "eviction[engine] host=hostB" in text and "keep" in text
     # MFU: productive-time-weighted over both attempts, in (0.30, 0.34)
     assert 0.30 < report["mfu"] < 0.34, report["mfu"]
     assert "restarts:" in text and "preemption" in text
