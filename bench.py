@@ -518,6 +518,13 @@ def main():
         # qwZ/hpZ on must record its zeropp block here so BENCH_*.json
         # rows stay attributable.
         "zeropp": {"quantized_weights": "off", "hpz": "off"},
+        # Autotuning (autotuning/; docs/PERFORMANCE.md "Autotuning") off:
+        # every training section above times the config it declares — no
+        # startup search swaps knobs under a timed window. The autotune
+        # A/B section below runs the search explicitly and records the
+        # adopted candidate in its own rows, so a tuned baseline adopted
+        # via tools/bench_gate.py --update-baseline stays attributable.
+        "autotuning": "off",
         # Serving-section config (docs/SERVING.md): the continuous-
         # batching rows below were measured under exactly this block.
         # Its memory-sink telemetry is scoped to the serving engine and
@@ -756,6 +763,81 @@ def main():
             step_time_zeropp_on_ms=round(times["on"] * 1e3, 3),
             zeropp_step_speedup=round(speedup, 3))
 
+    def sec_autotune():
+        # Tuned-vs-default A/B (docs/PERFORMANCE.md "Autotuning"): tiny
+        # GPT on a 2-slice mesh; the default engine times its declared
+        # config, the tuned engine runs the startup search (micro x gas
+        # re-split + the DCN quantization knobs) and times the adopted
+        # one. The tuner trials the default too, so tuned <= default up
+        # to timing noise — the gate's *_ms rows treat upward drift as
+        # regression, and a green round can adopt the tuned row as
+        # baseline via the documented --update-baseline flow (the gate
+        # treats the new section as informational until then).
+        import deepspeed_tpu
+        from deepspeed_tpu.models import make_gpt
+        from deepspeed_tpu.parallel.mesh import build_mesh
+
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        seq, gas0 = 64 if on_tpu else 32, 4
+        model, mcfg = make_gpt(
+            "tiny", dropout_rate=0.0,
+            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+            max_seq_len=max(seq, 128))
+        rng = np.random.default_rng(0)
+        params = model.init(
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(1)},
+            {"input_ids": np.zeros((2, seq), np.int32)})["params"]
+
+        def make_batches(micro, gas):
+            return {"input_ids": rng.integers(
+                0, mcfg.vocab_size, (gas, micro, seq), dtype=np.int32)}
+
+        base = {
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": gas0,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 2},
+        }
+        times, adopted = {}, None
+        for variant in ("default", "tuned"):
+            cfg_v = dict(base)
+            if variant == "tuned":
+                cfg_v["autotuning"] = {
+                    "micro_gas": [[1, gas0], [gas0, 1]],
+                    "dcn_quant_bits": [8, 32],
+                    "top_k": 3, "trial_steps": max(steps, 2),
+                    "trial_warmup": warmup,
+                }
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=model, params=params, mesh=build_mesh(slices=2),
+                config=cfg_v)
+            if variant == "tuned":
+                res = deepspeed_tpu.autotune(engine, make_batches)
+                adopted = res["adopted"]["name"]
+            batches = make_batches(
+                engine.train_micro_batch_size_per_gpu * engine.dp_size,
+                engine.gradient_accumulation_steps)
+            dt, _ = time_train_batches(engine, batches, max(steps, 2),
+                                       warmup, windows=2)
+            times[variant] = dt / max(steps, 2)
+            del engine
+        speedup = (times["default"] / times["tuned"]
+                   if times["tuned"] else 0.0)
+        log(f"[bench] autotune A/B (tiny GPT, 2-slice): default "
+            f"{times['default'] * 1e3:.1f} ms/step, tuned "
+            f"{times['tuned'] * 1e3:.1f} ms/step ({speedup:.2f}x, "
+            f"adopted '{adopted}', {time.time() - t0:.0f}s)")
+        result["autotune_adopted"] = adopted
+        result["autotune_step_speedup"] = round(speedup, 3)
+        _section_rows(
+            result, "autotune",
+            step_time_default_ms=round(times["default"] * 1e3, 3),
+            step_time_tuned_ms=round(times["tuned"] * 1e3, 3),
+            autotune_step_speedup=round(speedup, 3))
+
     sections = [("bert128", sec_bert128)]
     if on_tpu:
         sections += [("bert512", sec_bert512), ("gpt2", sec_gpt2),
@@ -765,7 +847,8 @@ def main():
     # The 2-slice overlap A/B needs an even multi-device split;
     # single-device CPU runs skip it (not a failure — no mesh to build).
     if n_chips_all >= 2 and n_chips_all % 2 == 0:
-        sections += [("comm_overlap", sec_comm_overlap)]
+        sections += [("comm_overlap", sec_comm_overlap),
+                     ("autotune", sec_autotune)]
     # The zeropp A/B additionally needs a data axis > 1 AND a
     # power-of-two chip count: on exactly 2 devices build_mesh(slices=2)
     # gives dcn=2 x data=1 (the hpZ gather axis is size 1), and an odd

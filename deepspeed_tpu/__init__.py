@@ -37,6 +37,7 @@ def initialize(args=None,
                param_partition_specs=None,
                dist_init_required: Optional[bool] = None,
                rng_seed: int = 0,
+               autotune_batches: Optional[Callable] = None,
                **kwargs):
     """Build the training engine (reference deepspeed/__init__.py:58).
 
@@ -201,6 +202,38 @@ def initialize(args=None,
                        sparse_gradients_handled=sparse_grads_handled,
                        **kwargs)
 
+    if cfg.autotuning.enabled:
+        # Startup config search (autotuning/; docs/PERFORMANCE.md
+        # "Autotuning"). Imported ONLY here — a default config never
+        # loads the package (the zero-overhead-off contract). The search
+        # needs a batch source shaped like the candidate's split:
+        # `autotune_batches(global_micro_batch, gas) -> batches pytree`.
+        import jax as _jax
+        if autotune_batches is not None and _jax.process_count() > 1:
+            # The explicit autotune() entry raises here (diverging
+            # per-host trial decisions => mismatched collectives); the
+            # automatic entry must not kill a multi-node job the user
+            # launched with --autotune — skip loudly instead.
+            from deepspeed_tpu.utils.logging import logger as _logger
+
+            _logger.warning(
+                "autotuning: measured trials are not coordinated across "
+                "processes yet — skipping the search on this %d-process "
+                "run (tune on a single-process mesh and ship the "
+                "adopted config)", _jax.process_count())
+        elif autotune_batches is not None:
+            from deepspeed_tpu.autotuning import autotune as _autotune
+
+            _autotune(engine, autotune_batches)
+        else:
+            from deepspeed_tpu.utils.logging import logger as _logger
+
+            _logger.warning(
+                "autotuning.enabled but no batch source: pass "
+                "initialize(autotune_batches=fn) with fn(global_micro, "
+                "gas) -> batches, or call deepspeed_tpu.autotune(engine, "
+                "make_batches) yourself — skipping the search")
+
     dataloader = None
     if training_data is not None:
         import jax
@@ -234,6 +267,21 @@ def argparse_suppress():
     import argparse
 
     return argparse.SUPPRESS
+
+
+def autotune(engine, make_batches, **kwargs):
+    """Run the observatory-driven startup config search on a live engine
+    and adopt the measured winner (autotuning/; docs/PERFORMANCE.md
+    "Autotuning"). ``make_batches(global_micro_batch, gas)`` must return
+    a training batch pytree with ``[gas, global_micro_batch, ...]``
+    leading dims. Reads the knob space from the engine's ``autotuning``
+    config block (an explicit call works with the block's defaults even
+    when ``enabled`` is false — enabled gates only the automatic run
+    inside :func:`initialize`). Returns the ``autotune_result.json``
+    document."""
+    from deepspeed_tpu.autotuning import autotune as _autotune
+
+    return _autotune(engine, make_batches, **kwargs)
 
 
 def init_inference(model=None, **kwargs):
@@ -278,7 +326,8 @@ def init_serving(model=None, config=None, **kwargs):
 
 
 __all__ = [
-    "initialize", "init_inference", "init_serving", "add_config_arguments",
+    "initialize", "init_inference", "init_serving", "autotune",
+    "add_config_arguments",
     "init_distributed", "zero_init",
     "build_mesh", "TPUEngine", "TrainState", "DeepSpeedTPUConfig",
     "DeepSpeedDataLoader", "RepeatingLoader", "ProcessTopology",

@@ -1,0 +1,27 @@
+"""Observatory-driven autotuner (docs/PERFORMANCE.md "Autotuning").
+
+Startup config search over {zero_stage, micro x gas, bucket_mb,
+dcn_quant_bits, overlap, zeropp}: enumerate + prune (ConfigError walls +
+engine-free capacity projection), rank with the modeled cost (flops/
+bytes roofline + grad-sync/param-gather wire seconds), measure the top-K
+with short in-process trials through the PR-13 ``_elastic_rebuild``
+path, adopt the measured winner. Never imported unless the search runs
+(the zero-overhead-off contract).
+"""
+
+from deepspeed_tpu.autotuning.cost import (compute_floor_seconds,
+                                           modeled_candidate_cost,
+                                           modeled_wire_seconds,
+                                           step_flops_bytes)
+from deepspeed_tpu.autotuning.search import (AUTOTUNE_METRIC_TAGS, TrialOOM,
+                                             autotune, render_result_table)
+from deepspeed_tpu.autotuning.space import (Candidate, batch_splits,
+                                            enumerate_candidates,
+                                            materialize)
+
+__all__ = [
+    "AUTOTUNE_METRIC_TAGS", "Candidate", "TrialOOM", "autotune",
+    "batch_splits", "compute_floor_seconds", "enumerate_candidates",
+    "materialize", "modeled_candidate_cost", "modeled_wire_seconds",
+    "render_result_table", "step_flops_bytes",
+]

@@ -1,0 +1,571 @@
+"""The startup config search — enumerate, prune, measure, adopt.
+
+Three stages (docs/PERFORMANCE.md "Autotuning"):
+
+1. **enumerate + prune** — :func:`~deepspeed_tpu.autotuning.space.
+   enumerate_candidates` generates the knob space; each candidate is
+   materialized into a full raw config dict and fed through the ordinary
+   ``DeepSpeedTPUConfig`` parse, so every ConfigError wall in the tree
+   prunes for free (``pruned_config``); survivors' HBM is projected
+   through the engine-free ``plan_capacity_from_config``
+   (telemetry/memory.py) and anything over ``headroom_frac`` x the HBM
+   limit is pruned too (``pruned_capacity``). Every pruned candidate is
+   logged — and recorded in the result JSON — with its reason.
+2. **measured trials** — survivors are ranked by the modeled cost
+   (autotuning/cost.py: flops/bytes roofline floor + the grad-sync /
+   param-gather modeled wire seconds); the top-K (plus the incumbent
+   ``default``, always) get a real in-process trial: the engine's config
+   is swapped through the PR-13 ``_elastic_rebuild`` path (same process,
+   same devices, state reinstalled from one pre-search snapshot every
+   time, so trials are isolated and the search leaves the engine exactly
+   where it found it), then compile + ``trial_steps`` timed steps.
+   Successive halving drops candidates slower than ``halving_factor`` x
+   the round's best before the longer confirmation round. A trial OOM
+   prunes the candidate (``trial_oom``) — the engine's OOM forensics
+   exit is suspended for the search, so a fat candidate can never kill
+   the run it is trying to speed up.
+3. **commit + report** — the measured winner's config is adopted (state
+   restored from the snapshot: step counters, rng and schedule continue
+   as if the search never ran), ``autotune_result.json`` persists the
+   full ranking with every verdict, the ``autotune/*`` gauges and the
+   ``autotune/adopted`` instant land in telemetry, and the whole window
+   is booked to the ``autotune_search`` goodput category (the engine's
+   goodput hooks are quiesced during trials, so trial steps can never
+   masquerade as productive time).
+
+Zero-overhead-off contract: nothing in this package is imported unless
+the search actually runs (``deepspeed_tpu.initialize`` gates the import
+on ``autotuning.enabled``), and the search never touches the step
+builders — the adopted engine is bit-identical to one hand-built with
+the winning config (tests/test_autotuning.py pins both).
+"""
+
+import contextlib
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.autotuning.cost import (modeled_candidate_cost,
+                                           step_flops_bytes)
+from deepspeed_tpu.autotuning.space import (Candidate, enumerate_candidates,
+                                            materialize)
+from deepspeed_tpu.config.config import ConfigError, DeepSpeedTPUConfig
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+RESULT_FORMAT = 1
+
+# Every metric tag this module can emit (gauges + the adoption instant) —
+# pinned against docs/OBSERVABILITY.md in BOTH directions by
+# tests/test_doc_lint.py, like GOODPUT/MEMORY_METRIC_TAGS.
+AUTOTUNE_METRIC_TAGS = frozenset({
+    "autotune/candidates",
+    "autotune/pruned",
+    "autotune/trials",
+    "autotune/search_sec",
+    "autotune/best_step_ms",
+    "autotune/adopted",
+})
+
+# Engine subsystems quiesced for the search window: trial steps must not
+# feed anomaly detectors, write interval checkpoints of trial states,
+# trip the elastic coordinator, exit the process on a trial OOM, book
+# goodput categories, feed fleet step-time estimates, or schedule
+# profiler captures. Restored verbatim afterwards.
+_QUIESCED_ATTRS = ("memory", "guardrails", "elastic", "ckpt_manager",
+                   "goodput", "fleet", "devicetime")
+
+
+class TrialOOM(RuntimeError):
+    """A measured trial ran the device out of memory — prune, never kill."""
+
+
+@contextlib.contextmanager
+def _quiesced(engine):
+    saved = {a: getattr(engine, a) for a in _QUIESCED_ATTRS}
+    for a in saved:
+        setattr(engine, a, None)
+    # The numerics observatory cannot be nulled — the step BUILDERS
+    # consult `engine.numerics` (the trial programs must match what the
+    # adopted engine will run) — so only its EMISSION is silenced: trial
+    # steps run under candidate configs and their per-group stats /
+    # quant-error gauges must never land in the production time series.
+    num_tel = None
+    if engine.numerics is not None:
+        num_tel = engine.numerics.telemetry
+        engine.numerics.telemetry = None
+    try:
+        yield
+    finally:
+        for a, v in saved.items():
+            setattr(engine, a, v)
+        if engine.numerics is not None:
+            engine.numerics.telemetry = num_tel
+
+
+def _check_engine(engine) -> None:
+    import jax
+
+    from deepspeed_tpu.parallel.mesh import PIPE_AXIS
+
+    if jax.process_count() > 1:
+        # Trial timings are per-process wall clock: two hosts measuring
+        # a near-tie would halve/adopt DIFFERENT configs and the rebuilt
+        # step programs' collectives stop matching — a distributed hang,
+        # not a slow pick. Until the measurements are agreed through a
+        # collective, the search is single-process only (the
+        # initialize() entry warns and skips instead of dying).
+        raise ConfigError(
+            "autotune: measured trials are not coordinated across "
+            "processes yet — per-host timings could adopt diverging "
+            "configs (mismatched collectives). Run the search on a "
+            "single-process mesh and ship the adopted config, or wait "
+            "for the cross-host agreement collective")
+    if engine.mesh.shape.get(PIPE_AXIS, 1) > 1:
+        raise ConfigError(
+            "autotune: the pipeline engine compiles its own schedule — "
+            "the in-process trial rebuild only re-places the fused "
+            "data-parallel tiers")
+    if hasattr(engine, "offloader") or engine._train_step is None:
+        # The explicit offload blocks are walled at config parse; the
+        # host-IMPLIED tier (optimizer.type "cpuadam" / any host_resident
+        # optimizer object) resolves only at engine level.
+        raise ConfigError(
+            "autotune cannot compose with the host optimizer tier "
+            "(offload_optimizer, or a host-resident optimizer such as "
+            "'cpuadam'): trial rebuilds only re-place device state")
+    if getattr(engine.optimizer, "needs_local_grads", False):
+        raise ConfigError(
+            "autotune cannot compose with 1-bit optimizers: rank-local "
+            "error-feedback buffers do not survive a trial rebuild")
+
+
+def _apply_candidate(engine, parsed_cfg, cand: Candidate, snapshot,
+                     devices) -> None:
+    """Swap the engine onto a candidate config in-process: replace the
+    parsed config, rebuild mesh/placement/step-fns through the one PR-13
+    world-change path (same devices, same world), and reinstall the
+    pre-search snapshot so every trial starts from identical state."""
+    engine.config = parsed_cfg
+    engine._elastic_rebuild(
+        devices=devices, slices=engine.dcn_size,
+        micro_batch=cand.micro, gas=cand.gas,
+        arrays=dict(snapshot.arrays), meta=snapshot.meta)
+
+
+def _run_trial(engine, cand: Candidate, make_batches: Callable,
+               steps: int, warmup: int) -> float:
+    """Compile + a few timed steps of the CURRENT engine config. Returns
+    measured seconds per optimizer step (a scalar loss fetch closes the
+    window — block_until_ready alone does not fence remote dispatch)."""
+    from deepspeed_tpu.telemetry.memory import is_resource_exhausted
+
+    batches = make_batches(cand.micro * engine.dp_size, cand.gas)
+    try:
+        loss = None
+        for _ in range(max(warmup, 1)):   # >=1: the compile must be paid
+            loss = engine.train_batch(batches)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batches)
+        float(loss)
+        return (time.perf_counter() - t0) / max(steps, 1)
+    except Exception as e:  # noqa: BLE001 — screened below
+        if is_resource_exhausted(e):
+            raise TrialOOM(str(e)[:500]) from e
+        raise
+
+
+def autotune(engine, make_batches: Callable[[int, int], Any], *,
+             result_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Run the three-stage search on a live engine and adopt the winner.
+
+    ``make_batches(global_micro_batch, gas)`` must return a training
+    batch pytree whose leaves carry ``[gas, global_micro_batch, ...]``
+    leading dims — the ``train_batch`` shape for the candidate's batch
+    split (the global micro batch is per-chip micro x dp world). The
+    returned dict is the persisted ``autotune_result.json`` document.
+    """
+    g = engine.goodput
+    if g is not None:
+        # Close the preceding interval first so the one autotune_search
+        # mark at the end books exactly the search window — marked in a
+        # finally so a failed search (every trial errored, no fitting
+        # candidate) can never leak its wall time into the NEXT
+        # category's mark (the exact-partition contract).
+        g.mark_gap()
+    try:
+        return _autotune_inner(engine, make_batches, result_dir=result_dir)
+    finally:
+        if g is not None:
+            g.mark("autotune_search")
+
+
+def _autotune_inner(engine, make_batches: Callable[[int, int], Any], *,
+                    result_dir: Optional[str]) -> Dict[str, Any]:
+    acfg = engine.config.autotuning
+    _check_engine(engine)
+    base_cfg = engine.config
+    base_dict = dict(getattr(base_cfg, "_param_dict", {}) or {})
+    mesh_shape = {str(k): int(v) for k, v in dict(engine.mesh.shape).items()}
+    devices = list(engine.mesh.devices.ravel())
+    t_start = time.monotonic()
+
+    candidates, notes = enumerate_candidates(base_cfg, mesh_shape,
+                                             engine.mesh.size)
+    for n in notes:
+        logger.warning("autotune: %s", n)
+    records: List[Dict[str, Any]] = []
+    parsed: Dict[str, DeepSpeedTPUConfig] = {}
+    dicts: Dict[str, Dict[str, Any]] = {}
+
+    # -- stage 1a: materialize + parse (the ConfigError walls) ----------
+    survivors: List[Candidate] = []
+    for cand in candidates:
+        rec: Dict[str, Any] = {"name": cand.name, "overrides": {},
+                               "status": "enumerated", "reason": None,
+                               "projected_device_bytes": None,
+                               "projected_headroom_bytes": None,
+                               "modeled_sec": None, "rank": None,
+                               "measured_step_ms": None}
+        records.append(rec)
+        try:
+            d = materialize(base_dict, cand, base_cfg)
+            rec["overrides"] = dict(cand.overrides)
+            parsed[cand.name] = DeepSpeedTPUConfig(
+                d, world_size=base_cfg.world_size)
+            dicts[cand.name] = d
+            survivors.append(cand)
+        except (ConfigError, ValueError) as e:
+            rec["status"] = "pruned_config"
+            rec["reason"] = f"config: {e}"
+            logger.info("autotune: pruned %s — %s", cand.name, e)
+
+    # -- stage 1b: capacity projection (engine-free) --------------------
+    from deepspeed_tpu.telemetry.memory import plan_capacity_from_config
+
+    limit = _hbm_limit_bytes(engine, acfg)
+    fitting: List[Candidate] = []
+    for cand in survivors:
+        rec = _rec(records, cand.name)
+        try:
+            plan = plan_capacity_from_config(
+                parsed[cand.name], engine.state.params,
+                num_shards=mesh_shape.get("data", 1),
+                microbatch=cand.micro,
+                act_bytes_per_sample=acfg.activation_bytes_per_sample,
+                hbm_limit_bytes=limit)
+            chosen = next(r for r in plan["rows"] if r["chosen"])
+            dev_bytes = chosen["device_bytes"]
+            rec["projected_device_bytes"] = int(dev_bytes)
+            if limit:
+                rec["projected_headroom_bytes"] = int(limit - dev_bytes)
+                budget = acfg.headroom_frac * limit
+                if dev_bytes > budget:
+                    rec["status"] = "pruned_capacity"
+                    rec["reason"] = (
+                        f"capacity: projects {dev_bytes / 1024**3:.2f} GB "
+                        f"per device > {acfg.headroom_frac:.0%} of the "
+                        f"{limit / 1024**3:.2f} GB HBM limit")
+                    logger.info("autotune: pruned %s — %s", cand.name,
+                                rec["reason"])
+                    continue
+        except Exception as e:  # noqa: BLE001 — projection is advisory
+            logger.warning("autotune: capacity projection failed for %s "
+                           "(%s) — candidate kept", cand.name, e)
+        fitting.append(cand)
+
+    if not fitting:
+        raise ConfigError(
+            "autotune: every candidate was pruned (see the log / result "
+            "records) — the base config itself projects over the HBM "
+            "budget; raise autotuning.headroom_frac or fix the config")
+
+    search_sec = 0.0
+    result: Dict[str, Any] = {}
+    with _quiesced(engine):
+        # -- stage 2a: modeled ranking ----------------------------------
+        try:
+            batches = engine.put_batch(
+                make_batches(engine.train_micro_batch_size_per_gpu
+                             * engine.dp_size,
+                             engine.gradient_accumulation_steps),
+                leading_gas_dim=True)
+            fb = step_flops_bytes(engine, batches, engine._current_lr())
+        except Exception as e:  # noqa: BLE001 — ranking only
+            logger.warning("autotune: default-step cost analysis failed "
+                           "(%s) — ranking on wire model alone", e)
+            fb = {"flops": 0.0, "bytes_accessed": 0.0}
+        for cand in fitting:
+            rec = _rec(records, cand.name)
+            cost = modeled_candidate_cost(engine, parsed[cand.name],
+                                          cand.gas, fb)
+            rec["modeled_sec"] = cost["modeled_sec"]
+        ranked = sorted(fitting,
+                        key=lambda c: _rec(records, c.name)["modeled_sec"])
+        for i, cand in enumerate(ranked):
+            _rec(records, cand.name)["rank"] = i + 1
+        trial_list = ranked[:acfg.top_k]
+        if not any(c.name == "default" for c in trial_list):
+            # The incumbent is ALWAYS measured: "the winner beat the
+            # default" must be a measured statement, never a modeled one.
+            # Unless it was itself capacity-pruned — the tuner's prime
+            # scenario (the hand-picked config projects over HBM), in
+            # which case the comparison is vacuous and the search simply
+            # picks the fastest FITTING candidate.
+            incumbent = next((c for c in ranked if c.name == "default"),
+                             None)
+            if incumbent is not None:
+                trial_list.append(incumbent)
+        for cand in ranked[acfg.top_k:]:
+            rec = _rec(records, cand.name)
+            if rec["status"] == "enumerated" and cand not in trial_list:
+                rec["status"] = "not_trialed"
+                rec["reason"] = (f"ranked {rec['rank']} > top_k "
+                                 f"{acfg.top_k} by the modeled cost")
+
+        # -- stage 2b: measured trials + successive halving -------------
+        from deepspeed_tpu.resilience.checkpoint import snapshot_engine
+
+        snapshot = snapshot_engine(engine)
+        measured: Dict[str, float] = {}
+        for cand in trial_list:
+            rec = _rec(records, cand.name)
+            try:
+                _apply_candidate(engine, parsed[cand.name], cand,
+                                 snapshot, devices)
+                sec = _run_trial(engine, cand, make_batches,
+                                 acfg.trial_steps, acfg.trial_warmup)
+                measured[cand.name] = sec
+                rec["status"] = "trialed"
+                rec["measured_step_ms"] = round(sec * 1e3, 3)
+            except TrialOOM as e:
+                rec["status"] = "trial_oom"
+                rec["reason"] = f"trial OOM: {e}"
+                logger.warning("autotune: %s pruned — trial OOM", cand.name)
+                _recover(engine, parsed, candidates, snapshot, devices)
+            except Exception as e:  # noqa: BLE001 — a broken candidate
+                # must not kill the search (the default always completes:
+                # its config is the one the engine already ran)
+                rec["status"] = "trial_error"
+                rec["reason"] = f"trial failed: {type(e).__name__}: {e}"
+                logger.warning("autotune: %s pruned — %s", cand.name,
+                               rec["reason"])
+                _recover(engine, parsed, candidates, snapshot, devices)
+        if not measured:
+            raise ConfigError(
+                "autotune: every measured trial failed (see the result "
+                "records) — not adopting anything")
+
+        best = min(measured.values())
+        finalists = [c for c in trial_list
+                     if measured.get(c.name) is not None
+                     and measured[c.name] <= best * acfg.halving_factor]
+        for cand in trial_list:
+            sec = measured.get(cand.name)
+            if sec is not None and cand not in finalists:
+                rec = _rec(records, cand.name)
+                rec["status"] = "eliminated"
+                rec["reason"] = (
+                    f"successive halving: {sec * 1e3:.2f} ms/step > "
+                    f"{acfg.halving_factor:g} x best "
+                    f"{best * 1e3:.2f} ms/step")
+        if len(finalists) > 1:
+            # Confirmation round: longer windows for the close calls.
+            for cand in finalists:
+                rec = _rec(records, cand.name)
+                try:
+                    _apply_candidate(engine, parsed[cand.name], cand,
+                                     snapshot, devices)
+                    sec = _run_trial(engine, cand, make_batches,
+                                     acfg.trial_steps * 2,
+                                     acfg.trial_warmup)
+                    measured[cand.name] = sec
+                    rec["measured_step_ms"] = round(sec * 1e3, 3)
+                except TrialOOM as e:
+                    # The longer window raised live activation pressure:
+                    # same verdict class as a round-1 OOM.
+                    rec["status"] = "trial_oom"
+                    rec["reason"] = f"trial OOM: {e}"
+                    measured.pop(cand.name, None)
+                    _recover(engine, parsed, candidates, snapshot, devices)
+                except Exception as e:  # noqa: BLE001
+                    rec["status"] = "trial_error"
+                    rec["reason"] = (f"confirmation trial failed: "
+                                     f"{type(e).__name__}: {e}")
+                    measured.pop(cand.name, None)
+                    _recover(engine, parsed, candidates, snapshot, devices)
+            finalists = [c for c in finalists if c.name in measured]
+        if not finalists:
+            raise ConfigError(
+                "autotune: every finalist failed its confirmation trial "
+                "(see the result records) — not adopting anything")
+
+        winner = min(finalists, key=lambda c: measured[c.name])
+        wrec = _rec(records, winner.name)
+        wrec["status"] = "adopted"
+
+        # -- stage 3: commit -------------------------------------------
+        _apply_candidate(engine, parsed[winner.name], winner, snapshot,
+                         devices)
+        search_sec = time.monotonic() - t_start
+
+    # Quiesced subsystems are live again: re-arm the per-config caches
+    # the rebuilds skipped while they were None. (The autotune_search
+    # goodput mark lives in autotune()'s finally.)
+    if engine.goodput is not None:
+        engine.goodput.reset_flops()
+    if engine.memory is not None:
+        engine.memory.on_engine_init(engine)
+
+    from deepspeed_tpu.telemetry.goodput import config_hash
+    pruned = sum(1 for r in records
+                 if r["status"].startswith(("pruned", "trial_oom",
+                                            "trial_error")))
+    if base_cfg.elasticity_enabled:
+        # The ladder owns the batch keys, so the adopted config dict
+        # cannot pin the winning split — record it (and fold it into the
+        # hash so two splits never alias); re-initializing from the
+        # adopted config yields the ladder's HEAD split unless the
+        # adopted batch_triple is applied through the elastic machinery.
+        notes = notes + [
+            "elasticity owns the batch keys: the adopted config "
+            "re-derives the ladder's head (micro, gas) at initialize(); "
+            "the measured winner's split is recorded as "
+            "adopted.batch_triple"]
+    result = {
+        "format": RESULT_FORMAT,
+        "world_size": int(engine.mesh.size),
+        "mesh": mesh_shape,
+        "hbm_limit_bytes": (int(limit) if limit else None),
+        "headroom_frac": acfg.headroom_frac,
+        "top_k": acfg.top_k,
+        "search_sec": round(search_sec, 3),
+        "notes": notes,
+        "adopted": {
+            "name": winner.name,
+            "overrides": dict(winner.overrides),
+            # The triple rides the hash too: under the elastic ladder two
+            # batch splits materialize byte-identical config dicts, and
+            # two distinct candidates must never share a hash.
+            "batch_triple": [winner.micro, winner.gas,
+                             int(engine.dp_size)],
+            "config_hash": config_hash(
+                {**dicts[winner.name],
+                 "_autotune_batch_triple": [winner.micro, winner.gas]}),
+            "config": dicts[winner.name],
+            "measured_step_ms": wrec["measured_step_ms"],
+        },
+        "default_measured_step_ms": _rec(records,
+                                         "default")["measured_step_ms"],
+        "candidates": records,
+    }
+    log_dist("autotune result:\n" + render_result_table(result), ranks=[0])
+    _emit(engine, result, pruned=pruned,
+          # every candidate that RAN a trial — OOM'd/errored ones
+          # included (they paid trial time; docs define the gauge so)
+          trials=sum(1 for r in records
+                     if r["status"] in ("trialed", "eliminated", "adopted",
+                                        "trial_oom", "trial_error")))
+    _write_result(engine, acfg, result, result_dir)
+    return result
+
+
+def _rec(records: List[Dict[str, Any]], name: str) -> Dict[str, Any]:
+    return next(r for r in records if r["name"] == name)
+
+
+def _recover(engine, parsed, candidates, snapshot, devices) -> None:
+    """A failed candidate rebuild/trial may leave the engine mid-swap:
+    re-apply the incumbent so the next trial starts from a sane world."""
+    default = next(c for c in candidates if c.name == "default")
+    try:
+        _apply_candidate(engine, parsed["default"], default, snapshot,
+                         devices)
+    except Exception as e:  # noqa: BLE001 — now it IS fatal
+        raise RuntimeError(
+            "autotune: could not restore the default config after a "
+            f"failed trial: {e}") from e
+
+
+def _hbm_limit_bytes(engine, acfg) -> Optional[int]:
+    """Config override first (autotuning.hbm_limit_gb, then the memory
+    observatory's), else the tightest local device's ``bytes_limit``
+    (None on CPU — capacity pruning then reports verdict unknown and
+    prunes nothing)."""
+    if acfg.hbm_limit_gb:
+        return int(acfg.hbm_limit_gb * 1024**3)
+    mcfg = engine.config.telemetry.memory
+    if getattr(mcfg, "hbm_limit_gb", None):
+        return int(mcfg.hbm_limit_gb * 1024**3)
+    from deepspeed_tpu.telemetry.memory import collect_memory_snapshot
+
+    snap = collect_memory_snapshot()
+    limits = [d["stats"]["bytes_limit"] for d in snap["devices"]
+              if d.get("stats") and d["stats"].get("bytes_limit")]
+    return int(min(limits)) if limits else None
+
+
+def _emit(engine, result: Dict[str, Any], *, pruned: int,
+          trials: int) -> None:
+    tel = engine.telemetry
+    if tel is None or not getattr(tel, "enabled", False):
+        return
+    reg = tel.registry
+    step = int(engine.global_steps)
+    reg.gauge("autotune/candidates").set(len(result["candidates"]),
+                                         step=step)
+    reg.gauge("autotune/pruned").set(pruned, step=step)
+    reg.gauge("autotune/trials").set(trials, step=step)
+    reg.gauge("autotune/search_sec").set(result["search_sec"], step=step)
+    if result["adopted"]["measured_step_ms"] is not None:
+        reg.gauge("autotune/best_step_ms").set(
+            result["adopted"]["measured_step_ms"], step=step)
+    tel.instant("autotune/adopted", candidate=result["adopted"]["name"],
+                config_hash=result["adopted"]["config_hash"],
+                measured_step_ms=result["adopted"]["measured_step_ms"],
+                search_sec=result["search_sec"])
+    tel.flush()
+
+
+def _write_result(engine, acfg, result: Dict[str, Any],
+                  result_dir: Optional[str]) -> None:
+    tcfg = engine.config.telemetry
+    out_dir = result_dir or (tcfg.dir if getattr(tcfg, "enabled", False)
+                             else None)
+    if not out_dir:
+        return
+    from deepspeed_tpu.telemetry.fleet import (host_scoped_path,
+                                               telemetry_host_component)
+    from deepspeed_tpu.telemetry.goodput import _atomic_write_json
+
+    try:
+        path = os.path.join(out_dir, host_scoped_path(
+            acfg.result_file, telemetry_host_component()))
+        _atomic_write_json(path, result)
+        result["result_path"] = path
+    except (OSError, TypeError, ValueError) as e:
+        logger.warning("autotune: result write failed: %s", e)
+
+
+def render_result_table(result: Dict[str, Any]) -> str:
+    """The startup ranking table (also rendered, stdlib-side, by
+    tools/autotune_report.py from the persisted JSON)."""
+    lines = [
+        f"autotune: world {result['world_size']}, "
+        f"{len(result['candidates'])} candidates, adopted "
+        f"'{result['adopted']['name']}' in {result['search_sec']:.1f}s",
+        f"{'candidate':<28} {'status':<16} {'proj GB':>8} "
+        f"{'model ms':>9} {'meas ms':>8}  reason",
+    ]
+    lines.append("-" * len(lines[-1]))
+    for r in result["candidates"]:
+        proj = (f"{r['projected_device_bytes'] / 1024**3:8.3f}"
+                if r.get("projected_device_bytes") is not None else "     n/a")
+        model = (f"{r['modeled_sec'] * 1e3:9.3f}"
+                 if r.get("modeled_sec") is not None else "      n/a")
+        meas = (f"{r['measured_step_ms']:8.2f}"
+                if r.get("measured_step_ms") is not None else "     n/a")
+        lines.append(f"{r['name']:<28} {r['status']:<16} {proj} {model} "
+                     f"{meas}  {r.get('reason') or ''}")
+    return "\n".join(lines)

@@ -1,0 +1,315 @@
+"""Candidate enumeration — the knob space of the startup config search.
+
+The searched knobs are exactly the ones the observability stack proved
+workload-dependent: ``zero_stage`` (capacity vs gather traffic, PR 7's
+planner), the ``micro x gas`` re-split (same global batch, different
+activation footprint and scan length — the elastic ladder owns the valid
+splits), and the wire knobs ``bucket_mb`` / ``dcn_quant_bits`` /
+``overlap_grad_sync`` / ``zeropp`` whose right values ZeRO++
+(arXiv 2306.10209) and EQuARX (arXiv 2506.17615) show depend on model
+and mesh shape. Every list in the ``autotuning`` config block overrides
+the derived axis; empty lists derive from the runtime shape, and axes the
+mesh gives no meaning (comm knobs on a single-slice mesh, zeropp below
+stage 2) collapse to the base config's values instead of generating
+dead duplicates.
+
+A candidate is a plain record of knob values plus :func:`materialize`,
+which turns it into a full raw config dict the normal
+``DeepSpeedTPUConfig`` parse can validate — stage-1 pruning IS that
+parse, so every ConfigError wall in the tree prunes candidates for free.
+"""
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.config import constants as C
+
+# Derived-axis defaults (used only where the mesh activates the axis).
+DEFAULT_ZERO_STAGES = (0, 1, 2, 3)
+DEFAULT_DCN_QUANT_BITS = (8, 32)
+DEFAULT_ZEROPP_TIERS = ("off", "int8")
+# Divisor re-splits of micro x gas are capped when elasticity is off
+# (the ladder caps itself through micro_batch_sizes).
+MAX_DERIVED_SPLITS = 4
+
+
+@dataclass
+class Candidate:
+    """One point of the knob space. ``overrides`` records only the knobs
+    that differ from the base config — the result JSON stores it so a
+    reader sees what the candidate changed, not the whole config."""
+
+    name: str
+    zero_stage: int
+    micro: int
+    gas: int
+    hierarchical: Optional[str] = None   # None => base value
+    bucket_mb: Optional[float] = None
+    dcn_quant_bits: Optional[int] = None
+    overlap: Optional[str] = None
+    zeropp: Optional[str] = None         # off | bf16 | int8
+    overrides: Dict[str, Any] = field(default_factory=dict)
+
+
+def _divisor_splits(micro: int, gas: int) -> List[Tuple[int, int]]:
+    """All (micro, gas) re-splits preserving the per-chip product —
+    the non-elastic fallback axis, largest micro first."""
+    product = int(micro) * int(gas)
+    splits = [(m, product // m) for m in range(product, 0, -1)
+              if product % m == 0]
+    return splits
+
+
+def batch_splits(config, world_size: int) -> List[Tuple[int, int]]:
+    """The micro x gas axis: the elastic ladder's valid splits when the
+    ladder is enabled (:func:`deepspeed_tpu.elasticity.valid_batch_splits`
+    — ONE ladder implementation, not a copy), else ALL divisor re-splits
+    of the configured per-chip product. Every pair preserves the global
+    batch by construction; :func:`enumerate_candidates` caps the derived
+    divisor axis (with a note — never silently)."""
+    if config.elasticity_enabled:
+        from deepspeed_tpu.elasticity import valid_batch_splits
+
+        return valid_batch_splits({"elasticity": dict(config.elasticity)},
+                                  world_size)
+    return _divisor_splits(config.train_micro_batch_size_per_gpu,
+                           config.gradient_accumulation_steps)
+
+
+def enumerate_candidates(config, mesh_shape: Dict[str, int],
+                         world_size: int) -> Tuple[List[Candidate],
+                                                   List[str]]:
+    """The candidate list (base config first) plus human-readable notes
+    about every axis that was capped or collapsed — the no-silent-caps
+    rule: a reader of the log/result must see what was NOT searched."""
+    acfg = config.autotuning
+    notes: List[str] = []
+    dcn = int(mesh_shape.get("dcn", 1))
+    data = int(mesh_shape.get("data", 1))
+    base_comm = config.comm
+    base_zpp = config.zero_config.zeropp
+
+    stages = tuple(acfg.zero_stages) or DEFAULT_ZERO_STAGES
+    if acfg.micro_gas:
+        # Explicit pairs must still preserve the global batch — the
+        # whole contract ("the tuner never changes convergence") dies
+        # otherwise: a half-batch pair would trial ~2x "faster" and win.
+        from deepspeed_tpu.config.config import ConfigError
+
+        legal = set(batch_splits(config, world_size))
+        bad = [list(p) for p in acfg.micro_gas if tuple(p) not in legal]
+        if bad:
+            raise ConfigError(
+                f"autotuning.micro_gas pairs {bad} change the global "
+                f"batch (valid splits at world {world_size}: "
+                f"{sorted(legal, reverse=True)}) — the tuner only "
+                f"re-splits, never re-sizes, the batch")
+        splits = tuple(acfg.micro_gas)
+    else:
+        splits = tuple(batch_splits(config, world_size))
+    if not acfg.micro_gas and len(splits) > MAX_DERIVED_SPLITS:
+        # Cap the derived divisor axis, keeping the extremes + the
+        # configured split — and SAY so (the no-silent-caps rule).
+        base = (config.train_micro_batch_size_per_gpu,
+                config.gradient_accumulation_steps)
+        keep = {splits[0], splits[-1], base}
+        mid = [s for s in splits if s not in keep]
+        keep.update(mid[:max(0, MAX_DERIVED_SPLITS - len(keep))])
+        dropped = [s for s in splits if s not in keep]
+        splits = tuple(s for s in splits if s in keep)
+        notes.append(
+            f"micro x gas axis capped at {MAX_DERIVED_SPLITS} derived "
+            f"splits (dropped {sorted(dropped)}; list them in "
+            f"autotuning.micro_gas to search them)")
+
+    # Comm axes exist only where a DCN hop exists for them to tune.
+    if dcn > 1:
+        hier_axis = ((base_comm.hierarchical,) if base_comm.hierarchical
+                     in ("auto", "on") else ("off", "auto"))
+        bits_axis = tuple(acfg.dcn_quant_bits) or DEFAULT_DCN_QUANT_BITS
+        bucket_axis = tuple(acfg.bucket_mbs) or (base_comm.bucket_mb,)
+        overlap_axis = tuple(acfg.overlap) or (base_comm.overlap_grad_sync,)
+    else:
+        hier_axis = (base_comm.hierarchical,)
+        bits_axis = (base_comm.dcn_quant_bits,)
+        bucket_axis = (base_comm.bucket_mb,)
+        overlap_axis = (base_comm.overlap_grad_sync,)
+        if acfg.dcn_quant_bits or acfg.bucket_mbs or acfg.overlap:
+            notes.append("comm axes collapsed: single-slice mesh (dcn=1) "
+                         "has no DCN hop to tune")
+
+    zpp_axis = tuple(acfg.zeropp) or (
+        DEFAULT_ZEROPP_TIERS if data > 1 else ("off",))
+    if data <= 1 and acfg.zeropp:
+        notes.append("zeropp axis collapsed: data axis is 1 — the "
+                     "explicit param gather has nothing to gather")
+
+    base_zpp_tier = (base_zpp.quantized_weights
+                     if getattr(base_zpp, "active", False) else "off")
+
+    def base_knobs(stage: int, micro: int, gas: int) -> Candidate:
+        return Candidate(name="", zero_stage=stage, micro=micro, gas=gas,
+                         hierarchical=base_comm.hierarchical,
+                         bucket_mb=base_comm.bucket_mb,
+                         dcn_quant_bits=base_comm.dcn_quant_bits,
+                         overlap=base_comm.overlap_grad_sync,
+                         zeropp=base_zpp_tier)
+
+    out: List[Candidate] = []
+    seen = set()
+    seen_names = set()
+
+    def add(c: Candidate) -> None:
+        # overlap "auto" and "on" resolve identically (grad_sync.
+        # resolve_overlap) — normalize so behavioral duplicates dedupe;
+        # hierarchical "auto"/"on" likewise once the mesh admits it.
+        ov = "off" if c.overlap == "off" else "on"
+        hi = ("off" if c.hierarchical == "off" else "on")
+        key = (c.zero_stage, c.micro, c.gas, hi, c.bucket_mb,
+               c.dcn_quant_bits, ov, c.zeropp)
+        if key in seen:
+            return
+        seen.add(key)
+        # search.py keys records/configs by name — collisions would
+        # corrupt the evidence trail, so uniqueness is enforced here.
+        if c.name in seen_names:
+            n = 2
+            while f"{c.name}~{n}" in seen_names:
+                n += 1
+            c.name = f"{c.name}~{n}"
+        seen_names.add(c.name)
+        out.append(c)
+
+    # The base config is ALWAYS candidate 0 ("default"): the tuner's
+    # never-regress story needs the incumbent measured next to the
+    # challengers.
+    default = base_knobs(config.zero_config.stage,
+                         config.train_micro_batch_size_per_gpu,
+                         config.gradient_accumulation_steps)
+    default.name = "default"
+    add(default)
+
+    for stage in stages:
+        for micro, gas in splits:
+            for hier in hier_axis:
+                comm_active = dcn > 1 and hier in ("auto", "on")
+                for bits in (bits_axis if comm_active
+                             else (base_comm.dcn_quant_bits,)):
+                    for bucket in (bucket_axis if comm_active
+                                   else (base_comm.bucket_mb,)):
+                        for ov in (overlap_axis if comm_active
+                                   else (base_comm.overlap_grad_sync,)):
+                            for zpp in (zpp_axis if stage >= 2
+                                        else ("off",)):
+                                c = Candidate(
+                                    name="", zero_stage=int(stage),
+                                    micro=int(micro), gas=int(gas),
+                                    hierarchical=hier,
+                                    bucket_mb=float(bucket),
+                                    dcn_quant_bits=int(bits),
+                                    overlap=ov, zeropp=zpp)
+                                c.name = _candidate_name(c, comm_active)
+                                add(c)
+
+    if len(out) > acfg.max_candidates:
+        notes.append(
+            f"candidate space capped at autotuning.max_candidates="
+            f"{acfg.max_candidates} (enumerated {len(out)}; raise the cap "
+            f"or narrow the override lists to search the rest)")
+        out = out[:acfg.max_candidates]
+    return out, notes
+
+
+def _candidate_name(c: Candidate, comm_active: bool) -> str:
+    parts = [f"stage{c.zero_stage}", f"mb{c.micro}x{c.gas}"]
+    if comm_active:
+        parts.append(f"{'hier' if c.hierarchical != 'off' else 'nohier'}")
+        if c.hierarchical != "off":
+            parts.append(f"b{c.dcn_quant_bits}")
+            parts.append(f"bk{c.bucket_mb:g}")
+            if c.overlap == "off":
+                parts.append("noovl")
+    if c.zeropp and c.zeropp != "off":
+        parts.append(f"zpp-{c.zeropp}")
+    return "-".join(parts)
+
+
+def materialize(base_param_dict: Dict[str, Any], cand: Candidate,
+                config) -> Dict[str, Any]:
+    """The candidate's full raw config dict: the base dict with the
+    candidate's knobs written over it — parseable by the normal
+    ``DeepSpeedTPUConfig``, so stage-1 pruning is the ordinary config
+    validation. Autotuning is disabled in the product (a candidate must
+    never recursively search), and the batch triple is written explicitly
+    only when the elastic ladder is NOT in control (the ladder owns the
+    batch keys; the trial rebuild passes micro/gas directly)."""
+    d = copy.deepcopy(dict(base_param_dict or {}))
+    # Keep the user's knob lists (a later explicit re-search must see the
+    # same space), flip only the auto-run gate: a candidate — including
+    # the adopted one — must never recursively search at initialize().
+    d[C.AUTOTUNING] = {**dict(d.get(C.AUTOTUNING) or {}),
+                       C.AUTOTUNING_ENABLED: False}
+
+    zo = dict(d.get(C.ZERO_OPTIMIZATION) or {})
+    zo["stage"] = int(cand.zero_stage)
+    if cand.zeropp and cand.zeropp != "off":
+        zpp = dict(zo.get("zeropp") or {})
+        zpp["quantized_weights"] = cand.zeropp
+        zpp.setdefault("quant_block_size",
+                       int(config.zero_config.zeropp.quant_block_size))
+        # hpZ only means something with a DCN axis to keep gathers off.
+        zpp.setdefault("hpz", "on" if config.mesh.slices > 1 else "off")
+        zo["zeropp"] = zpp
+        # The explicit gather needs non-persistent leaves to serve;
+        # keep the user's threshold when set, else gather everything.
+        zo.setdefault("stage3_param_persistence_threshold", 0)
+    else:
+        zo.pop("zeropp", None)
+    d[C.ZERO_OPTIMIZATION] = zo
+
+    comm = dict(d.get(C.COMM) or {})
+    if cand.hierarchical is not None:
+        comm[C.COMM_HIERARCHICAL] = cand.hierarchical
+    if cand.bucket_mb is not None:
+        comm[C.COMM_BUCKET_MB] = float(cand.bucket_mb)
+    if cand.dcn_quant_bits is not None:
+        comm[C.COMM_DCN_QUANT_BITS] = int(cand.dcn_quant_bits)
+    if cand.overlap is not None:
+        comm[C.COMM_OVERLAP_GRAD_SYNC] = cand.overlap
+    d[C.COMM] = comm
+
+    if not config.elasticity_enabled:
+        dp = config.data_parallel_size
+        d[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = int(cand.micro)
+        d[C.GRADIENT_ACCUMULATION_STEPS] = int(cand.gas)
+        d[C.TRAIN_BATCH_SIZE] = int(cand.micro) * int(cand.gas) * dp
+        d.pop(C.TRAIN_MICRO_BATCH_SIZE_PER_CHIP, None)
+
+    cand.overrides = _diff_overrides(cand, config)
+    return d
+
+
+def _diff_overrides(cand: Candidate, config) -> Dict[str, Any]:
+    """The knobs the candidate changes vs the base config (for the
+    result record / report table)."""
+    base_zpp = config.zero_config.zeropp
+    base_tier = (base_zpp.quantized_weights
+                 if getattr(base_zpp, "active", False) else "off")
+    out: Dict[str, Any] = {}
+    if cand.zero_stage != config.zero_config.stage:
+        out["zero_stage"] = cand.zero_stage
+    if (cand.micro, cand.gas) != (config.train_micro_batch_size_per_gpu,
+                                  config.gradient_accumulation_steps):
+        out["micro_gas"] = [cand.micro, cand.gas]
+    if cand.hierarchical not in (None, config.comm.hierarchical):
+        out["hierarchical"] = cand.hierarchical
+    if cand.bucket_mb not in (None, config.comm.bucket_mb):
+        out["bucket_mb"] = cand.bucket_mb
+    if cand.dcn_quant_bits not in (None, config.comm.dcn_quant_bits):
+        out["dcn_quant_bits"] = cand.dcn_quant_bits
+    if cand.overlap not in (None, config.comm.overlap_grad_sync):
+        out["overlap_grad_sync"] = cand.overlap
+    if cand.zeropp not in (None, base_tier):
+        out["zeropp"] = cand.zeropp
+    return out

@@ -1006,6 +1006,186 @@ class ServingConfig:
         return cfg
 
 
+def _int_tuple(v, name: str) -> tuple:
+    if v is None:
+        return ()
+    if not isinstance(v, (list, tuple)):
+        raise ConfigError(f"{name} must be a list, got {type(v).__name__}")
+    return tuple(int(x) for x in v)
+
+
+def _float_tuple(v, name: str) -> tuple:
+    if v is None:
+        return ()
+    if not isinstance(v, (list, tuple)):
+        raise ConfigError(f"{name} must be a list, got {type(v).__name__}")
+    return tuple(float(x) for x in v)
+
+
+def _str_tuple(v, name: str) -> tuple:
+    if v is None:
+        return ()
+    if not isinstance(v, (list, tuple)):
+        raise ConfigError(f"{name} must be a list, got {type(v).__name__}")
+    return tuple(str(x).lower() for x in v)
+
+
+@dataclass
+class AutotuningConfig:
+    """``autotuning`` block — the startup config search
+    (autotuning/; docs/PERFORMANCE.md "Autotuning").
+
+    Three stages: enumerate the knob space (every list here overrides the
+    derived default axis), prune candidates that fail the ConfigError
+    walls at parse or project over ``headroom_frac`` x HBM through the
+    engine-free capacity projection (telemetry/memory.py), then run
+    short in-process measured trials of the ``top_k``
+    projected-fastest survivors (compile + ``trial_steps`` timed steps
+    each, successive-halving early stop at ``halving_factor``) and adopt
+    the measured winner. ``enabled`` gates only the automatic run inside
+    ``deepspeed_tpu.initialize`` (and the launcher's ``--autotune`` env
+    handshake); an explicit ``deepspeed_tpu.autotune(engine, ...)`` call
+    reads the knobs regardless. Default OFF is provably free: no
+    autotuning import at engine init, zero extra syncs, bit-identical
+    lowered step."""
+
+    enabled: bool = C.AUTOTUNING_ENABLED_DEFAULT
+    zero_stages: tuple = ()
+    micro_gas: tuple = ()            # ((micro, gas), ...) overrides
+    bucket_mbs: tuple = ()
+    dcn_quant_bits: tuple = ()
+    overlap: tuple = ()              # overlap_grad_sync values
+    zeropp: tuple = ()               # quantized_weights tiers
+    top_k: int = C.AUTOTUNING_TOP_K_DEFAULT
+    trial_steps: int = C.AUTOTUNING_TRIAL_STEPS_DEFAULT
+    trial_warmup: int = C.AUTOTUNING_TRIAL_WARMUP_DEFAULT
+    halving_factor: float = C.AUTOTUNING_HALVING_FACTOR_DEFAULT
+    headroom_frac: float = C.AUTOTUNING_HEADROOM_FRAC_DEFAULT
+    activation_bytes_per_sample: float = C.AUTOTUNING_ACT_BYTES_DEFAULT
+    hbm_limit_gb: Optional[float] = None
+    max_candidates: int = C.AUTOTUNING_MAX_CANDIDATES_DEFAULT
+    result_file: str = C.AUTOTUNING_RESULT_FILE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "AutotuningConfig":
+        d = d or {}
+        if C.AUTOTUNING_ENABLED in d and d[C.AUTOTUNING_ENABLED] is not None:
+            # An explicit value always wins — the tuner's own candidate
+            # configs carry `enabled: false` precisely so a candidate
+            # (the adopted one included) can never recursively search.
+            enabled = bool(d[C.AUTOTUNING_ENABLED])
+        else:
+            # Launcher handshake: `dstpu --autotune` exports the env so
+            # unmodified scripts (no explicit key) enable the search
+            # through their config parse.
+            enabled = (C.AUTOTUNING_ENABLED_DEFAULT
+                       or os.environ.get(C.AUTOTUNING_ENV, "")
+                       not in ("", "0"))
+        mg = d.get(C.AUTOTUNING_MICRO_GAS)
+        micro_gas = ()
+        if mg is not None:
+            if not isinstance(mg, (list, tuple)) or not all(
+                    isinstance(p, (list, tuple)) and len(p) == 2
+                    for p in mg):
+                raise ConfigError(
+                    "autotuning.micro_gas must be a list of [micro, gas] "
+                    f"pairs, got {mg!r}")
+            micro_gas = tuple((int(m), int(g)) for m, g in mg)
+        cfg = cls(
+            enabled=enabled,
+            zero_stages=_int_tuple(d.get(C.AUTOTUNING_ZERO_STAGES),
+                                   "autotuning.zero_stages"),
+            micro_gas=micro_gas,
+            bucket_mbs=_float_tuple(d.get(C.AUTOTUNING_BUCKET_MBS),
+                                    "autotuning.bucket_mbs"),
+            dcn_quant_bits=_int_tuple(d.get(C.AUTOTUNING_DCN_QUANT_BITS),
+                                      "autotuning.dcn_quant_bits"),
+            overlap=_str_tuple(d.get(C.AUTOTUNING_OVERLAP),
+                               "autotuning.overlap"),
+            zeropp=_str_tuple(d.get(C.AUTOTUNING_ZEROPP),
+                              "autotuning.zeropp"),
+            top_k=int(_get(d, C.AUTOTUNING_TOP_K,
+                           C.AUTOTUNING_TOP_K_DEFAULT)),
+            trial_steps=int(_get(d, C.AUTOTUNING_TRIAL_STEPS,
+                                 C.AUTOTUNING_TRIAL_STEPS_DEFAULT)),
+            trial_warmup=int(_get(d, C.AUTOTUNING_TRIAL_WARMUP,
+                                  C.AUTOTUNING_TRIAL_WARMUP_DEFAULT)),
+            halving_factor=float(_get(d, C.AUTOTUNING_HALVING_FACTOR,
+                                      C.AUTOTUNING_HALVING_FACTOR_DEFAULT)),
+            headroom_frac=float(_get(d, C.AUTOTUNING_HEADROOM_FRAC,
+                                     C.AUTOTUNING_HEADROOM_FRAC_DEFAULT)),
+            activation_bytes_per_sample=float(_get(
+                d, C.AUTOTUNING_ACT_BYTES, C.AUTOTUNING_ACT_BYTES_DEFAULT)),
+            hbm_limit_gb=(float(d[C.AUTOTUNING_HBM_LIMIT_GB])
+                          if d.get(C.AUTOTUNING_HBM_LIMIT_GB) is not None
+                          else None),
+            max_candidates=int(_get(d, C.AUTOTUNING_MAX_CANDIDATES,
+                                    C.AUTOTUNING_MAX_CANDIDATES_DEFAULT)),
+            result_file=str(_get(d, C.AUTOTUNING_RESULT_FILE,
+                                 C.AUTOTUNING_RESULT_FILE_DEFAULT)),
+        )
+        if cfg.top_k < 1:
+            raise ConfigError(
+                f"autotuning.top_k must be >= 1, got {cfg.top_k}")
+        if cfg.trial_steps < 1:
+            raise ConfigError(
+                f"autotuning.trial_steps must be >= 1, got "
+                f"{cfg.trial_steps}")
+        if cfg.trial_warmup < 0:
+            raise ConfigError(
+                f"autotuning.trial_warmup must be >= 0, got "
+                f"{cfg.trial_warmup}")
+        if cfg.halving_factor <= 1.0:
+            raise ConfigError(
+                f"autotuning.halving_factor must be > 1 (a factor <= 1 "
+                f"would eliminate every candidate including the best), "
+                f"got {cfg.halving_factor}")
+        if not (0.0 < cfg.headroom_frac <= 1.0):
+            raise ConfigError(
+                f"autotuning.headroom_frac must be in (0, 1], got "
+                f"{cfg.headroom_frac}")
+        if cfg.hbm_limit_gb is not None and cfg.hbm_limit_gb <= 0:
+            raise ConfigError(
+                f"autotuning.hbm_limit_gb must be positive, got "
+                f"{cfg.hbm_limit_gb}")
+        if cfg.max_candidates < 1:
+            raise ConfigError(
+                f"autotuning.max_candidates must be >= 1, got "
+                f"{cfg.max_candidates}")
+        bad = [s for s in cfg.zero_stages if s not in (0, 1, 2, 3)]
+        if bad:
+            raise ConfigError(
+                f"autotuning.zero_stages must be drawn from 0-3, got {bad}")
+        bad = [b for b in cfg.dcn_quant_bits if b not in (8, 16, 32)]
+        if bad:
+            raise ConfigError(
+                f"autotuning.dcn_quant_bits must be drawn from 8/16/32, "
+                f"got {bad}")
+        bad = [o for o in cfg.overlap if o not in ("auto", "on", "off")]
+        if bad:
+            raise ConfigError(
+                f"autotuning.overlap must be drawn from auto/on/off, "
+                f"got {bad}")
+        bad = [z for z in cfg.zeropp if z not in ("off", "bf16", "int8")]
+        if bad:
+            raise ConfigError(
+                f"autotuning.zeropp must be drawn from off/bf16/int8, "
+                f"got {bad}")
+        if any(m < 1 or g < 1 for m, g in cfg.micro_gas):
+            raise ConfigError(
+                f"autotuning.micro_gas pairs must be positive, got "
+                f"{cfg.micro_gas}")
+        # The result file is discovered by pattern by the stdlib-only
+        # autotune_report (same argument as memory.plan_file).
+        if not (cfg.result_file.startswith("autotune_result")
+                and cfg.result_file.endswith(".json")):
+            raise ConfigError(
+                "autotuning.result_file must match 'autotune_result*.json' "
+                f"(tools/autotune_report.py discovers it by that pattern), "
+                f"got '{cfg.result_file}'")
+        return cfg
+
+
 @dataclass
 class TensorboardConfig:
     enabled: bool = False
@@ -1164,6 +1344,7 @@ class DeepSpeedTPUConfig:
         self.comm = CommConfig.from_dict(d.get(C.COMM))
         self.guardrails = GuardrailsConfig.from_dict(d.get(C.GUARDRAILS))
         self.serving = ServingConfig.from_dict(d.get(C.SERVING))
+        self.autotuning = AutotuningConfig.from_dict(d.get(C.AUTOTUNING))
         self.sparse_attention = d.get(C.SPARSE_ATTENTION)
         self.pipeline = dict(d.get(C.PIPELINE, {}))
         self.eigenvalue = dict(d.get(C.EIGENVALUE, {}))
@@ -1324,6 +1505,33 @@ class DeepSpeedTPUConfig:
                     "optimizers: the error-compensated compressed-"
                     "momentum buffers are rank-local and do not survive "
                     "a world change")
+        if self.autotuning.enabled:
+            # The tuner's measured trials swap configs in-process through
+            # the fused data-parallel tiers' _elastic_rebuild path; the
+            # tiers below own their own state layout or wire protocol and
+            # cannot be rebuilt behind their backs — same walls (and the
+            # same reasons) as elasticity.live. The host-IMPLIED optimizer
+            # tier (optimizer.type "cpuadam") resolves only at engine
+            # level; deepspeed_tpu.autotune() re-checks it there.
+            if (self.mesh.pipe > 1
+                    or int(self.pipeline.get("stages", 1)) > 1):
+                raise ConfigError(
+                    "autotuning cannot compose with pipeline parallelism: "
+                    "the pipe engine compiles its own schedule and the "
+                    "in-process trial rebuild only re-places the fused "
+                    "data-parallel tiers")
+            if (self.zero_config.offload_param.enabled
+                    or self.zero_config.offload_optimizer.enabled):
+                raise ConfigError(
+                    "autotuning cannot compose with the offload tiers: "
+                    "host-resident master/param state is laid out per-"
+                    "partition and the in-process trial rebuild "
+                    "(install_state_arrays) only re-places device state")
+            if str(self.optimizer_name or "").startswith("onebit"):
+                raise ConfigError(
+                    "autotuning cannot compose with 1-bit optimizers: the "
+                    "error-compensated compressed-momentum buffers are "
+                    "rank-local and do not survive a trial rebuild")
         if (self.telemetry.memory.enabled and self.guardrails.watchdog.enabled
                 and self.telemetry.memory.oom_exit_code
                 == self.guardrails.watchdog.exit_code):

@@ -469,3 +469,53 @@ COMM_DCN_GBPS_DEFAULT = 12.5
 # ZeroPPConfig owns the keys/defaults (they live beside the other
 # zero_optimization key constants); the param-hop comm gauge names are
 # declared in comm/grad_sync.py COMM_PARAM_METRIC_TAGS, doc-lint-pinned.
+
+#############################################
+# Autotuning (autotuning/; docs/PERFORMANCE.md "Autotuning"): startup
+# config search — enumerate the knob space, prune against the ConfigError
+# walls and the capacity projection, rank survivors with the modeled cost
+# model, measure the top-K with short in-process trials, adopt the
+# fastest. Default OFF: no autotuning import at engine init, zero extra
+# syncs, bit-identical lowered step (tests/test_autotuning.py pins it).
+#############################################
+AUTOTUNING = "autotuning"
+AUTOTUNING_ENABLED = "enabled"
+AUTOTUNING_ENABLED_DEFAULT = False
+# Launcher handshake: `dstpu --autotune ...` exports this env for every
+# child so unmodified training scripts pick the search up through their
+# config parse (the script still owes the tuner a batch source — see
+# deepspeed_tpu.autotune / initialize(autotune_batches=...)).
+AUTOTUNING_ENV = "DSTPU_AUTOTUNE"
+# Knob-space overrides. Empty tuples mean "derive from the runtime
+# shape": stages 0-3, the elastic ladder's (micro, gas) splits (divisor
+# re-splits of the configured product when elasticity is off), and the
+# comm/zeropp axes only where the mesh gives them meaning (dcn > 1).
+AUTOTUNING_ZERO_STAGES = "zero_stages"
+AUTOTUNING_MICRO_GAS = "micro_gas"               # [[micro, gas], ...]
+AUTOTUNING_BUCKET_MBS = "bucket_mbs"
+AUTOTUNING_DCN_QUANT_BITS = "dcn_quant_bits"
+AUTOTUNING_OVERLAP = "overlap"                   # overlap_grad_sync values
+AUTOTUNING_ZEROPP = "zeropp"                     # "off" | "bf16" | "int8"
+# Measured-trial knobs: top_k survivors get compile + trial_steps timed
+# steps; successive halving drops candidates slower than
+# halving_factor x the round's best before the confirmation round.
+AUTOTUNING_TOP_K = "top_k"
+AUTOTUNING_TOP_K_DEFAULT = 3
+AUTOTUNING_TRIAL_STEPS = "trial_steps"
+AUTOTUNING_TRIAL_STEPS_DEFAULT = 3
+AUTOTUNING_TRIAL_WARMUP = "trial_warmup"
+AUTOTUNING_TRIAL_WARMUP_DEFAULT = 1
+AUTOTUNING_HALVING_FACTOR = "halving_factor"
+AUTOTUNING_HALVING_FACTOR_DEFAULT = 1.5
+# Capacity wall: a candidate whose projected device bytes exceed
+# headroom_frac x the HBM limit is pruned before any trial (the
+# projection is telemetry/memory.py plan_capacity, engine-free).
+AUTOTUNING_HEADROOM_FRAC = "headroom_frac"
+AUTOTUNING_HEADROOM_FRAC_DEFAULT = 0.9
+AUTOTUNING_ACT_BYTES = "activation_bytes_per_sample"
+AUTOTUNING_ACT_BYTES_DEFAULT = 0.0
+AUTOTUNING_HBM_LIMIT_GB = "hbm_limit_gb"         # None -> device limit
+AUTOTUNING_MAX_CANDIDATES = "max_candidates"
+AUTOTUNING_MAX_CANDIDATES_DEFAULT = 64
+AUTOTUNING_RESULT_FILE = "result_file"
+AUTOTUNING_RESULT_FILE_DEFAULT = "autotune_result.json"

@@ -11,6 +11,7 @@ from deepspeed_tpu.elasticity.elasticity import (
     ensure_immutable_elastic_config,
     highly_composite_numbers,
     pick_preferred_world,
+    valid_batch_splits,
     world_change_plan,
 )
 
@@ -22,5 +23,6 @@ __all__ = [
     "ElasticityIncompatibleWorldSize", "compute_elastic_config",
     "elastic_config_hash", "elasticity_enabled",
     "ensure_immutable_elastic_config", "highly_composite_numbers",
-    "pick_preferred_world", "world_change_plan", "config",
+    "pick_preferred_world", "valid_batch_splits", "world_change_plan",
+    "config",
 ]

@@ -229,6 +229,39 @@ def pick_preferred_world(ds_config: Dict, available_chips: int,
     return max(fitting)
 
 
+def _splits_for(final_batch: int, micro_batches: Sequence[int],
+                world_size: int) -> List[Tuple[int, int]]:
+    """The ONE (micro, gas) split derivation: every configured micro
+    batch dividing ``final_batch // world_size``, largest first."""
+    per_world = final_batch // world_size
+    return [(mb, per_world // mb)
+            for mb in sorted(set(micro_batches), reverse=True)
+            if per_world % mb == 0]
+
+
+def valid_batch_splits(ds_config: Dict, world_size: int,
+                       target_version: str = __version__
+                       ) -> List[Tuple[int, int]]:
+    """Every ``(micro_batch, gas)`` split the elastic ladder allows at
+    ``world_size`` chips, largest micro batch first. The final train
+    batch is a property of the ladder, so every pair returned satisfies
+    ``micro x gas x world == final_batch`` — the invariant that keeps
+    convergence unchanged across re-splits. This is the ONE micro/gas
+    derivation in the tree: :func:`compute_elastic_config`'s
+    ``world_size`` mode (and therefore :func:`world_change_plan`) picks
+    its micro batch from the head of this list, and the autotuner's
+    micro x gas search axis (autotuning/space.py) enumerates the whole
+    list instead of re-deriving ladder math. Raises
+    :class:`ElasticityIncompatibleWorldSize` when ``world_size`` is not a
+    ladder rung."""
+    final_batch, valid = compute_elastic_config(ds_config, target_version)
+    if world_size not in valid:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not in valid chip counts {valid}")
+    ecfg = ElasticityConfig(dict(ds_config[ELASTICITY_KEY]))
+    return _splits_for(final_batch, ecfg.micro_batches, world_size)
+
+
 def world_change_plan(ds_config: Dict, available_chips: int,
                       target_version: str = __version__
                       ) -> Tuple[int, int, int]:
@@ -300,11 +333,13 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str,
         if world_size not in valid:
             raise ElasticityIncompatibleWorldSize(
                 f"world size {world_size} not in valid chip counts {valid}")
-        micro = next((mb for mb in sorted(set(ecfg.micro_batches), reverse=True)
-                      if (final_batch // world_size) % mb == 0), None)
-        if micro is None:
+        # One split derivation in the tree (_splits_for, largest-micro
+        # first): this mode returns its head; valid_batch_splits — the
+        # autotuner's re-split axis — returns the whole list.
+        splits = _splits_for(final_batch, ecfg.micro_batches, world_size)
+        if not splits:
             raise ElasticityError(
                 f"no configured micro batch divides "
                 f"{final_batch}//{world_size}")
-        return final_batch, valid, micro
+        return final_batch, valid, splits[0][0]
     return final_batch, valid

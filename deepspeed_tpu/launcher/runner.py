@@ -80,6 +80,15 @@ def parse_args(args=None):
                              "preemption_warned, restarted normally). Set "
                              "this when the ds-config overrides "
                              "elasticity.live.exit_code; default 115")
+    parser.add_argument("--autotune", action="store_true",
+                        help="Run the startup config search before "
+                             "training (autotuning/; docs/PERFORMANCE.md "
+                             "'Autotuning'): exports DSTPU_AUTOTUNE=1 so "
+                             "every worker's config parse enables the "
+                             "autotuning block. The script must supply "
+                             "the batch source — initialize("
+                             "autotune_batches=fn) or an explicit "
+                             "deepspeed_tpu.autotune(engine, fn) call")
     parser.add_argument("--run_dir", type=str, default=None,
                         help="Goodput run dir (the job's telemetry.dir): "
                              "with --auto_resume, each attempt's run "
@@ -271,6 +280,12 @@ def main(args=None):
     if not active:
         raise RuntimeError("no hosts left after filters")
     hosts = list(active.keys())
+    if args.autotune:
+        # DSTPU_* is in the propagated-env prefix list, so every worker
+        # (local, ssh/pdsh remote, or supervisor restart) inherits it and
+        # AutotuningConfig.from_dict flips enabled at config parse.
+        from deepspeed_tpu.config.constants import AUTOTUNING_ENV
+        os.environ[AUTOTUNING_ENV] = "1"
     env = propagated_env()
 
     multi_node = args.force_multi or len(hosts) > 1

@@ -2442,6 +2442,21 @@ class TPUEngine:
         # templates only), then the snapshot's values land on it through
         # the one shared host-arrays→engine path.
         self.state = self._init_state(params_host, rng_seed=0)
+        # ZeRO++ weight path: re-derive the plan from the (possibly
+        # rebuilt) config against the new placement. Live elasticity
+        # walls zeropp off at config parse, so this only ever fires on
+        # the autotuner's trial rebuilds (autotuning/search.py), whose
+        # candidate configs flip the block on/off per trial.
+        self.zeropp = cfg.zero_config.zeropp
+        self.param_gather_plan = None
+        if self.zeropp.active:
+            from deepspeed_tpu.comm.grad_sync import ParamGatherPlan
+            self.param_gather_plan = ParamGatherPlan(
+                self.zeropp, mesh,
+                param_template=self.state.params,
+                param_specs=self.param_specs,
+                measure_quant_error=self.numerics is not None)
+            log_dist(self.param_gather_plan.describe(), ranks=[0])
         install_state_arrays(
             self, arrays, step=int(meta["step"]),
             micro_steps=int(meta["micro_steps"]),

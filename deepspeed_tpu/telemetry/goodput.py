@@ -92,6 +92,12 @@ CATEGORIES = (
     # measure), so the exact-partition invariant holds and reshard time
     # never leaks into idle_other.
     "elastic_reshard",
+    # Startup config search (autotuning/): candidate pruning + in-process
+    # measured trials + winner adoption. The tuner quiesces the engine's
+    # goodput hooks for the search window and books the WHOLE window with
+    # one mark, so trial steps can never masquerade as productive_step
+    # and the exact-partition invariant holds.
+    "autotune_search",
     "idle_other",
 )
 

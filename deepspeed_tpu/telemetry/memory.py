@@ -500,6 +500,110 @@ def plan_capacity(*, compute_params_bytes: float, grads_bytes: float,
     }
 
 
+# ---------------------------------------------------------------------------
+# Standalone projection: raw config + parameter SHAPES, no live engine.
+# The autotuner's pruning path (autotuning/search.py) projects every
+# candidate's HBM before any engine exists; the engine call site
+# (_plan_from_engine below) is untouched and keeps feeding plan_capacity
+# from the live ledger. tests/test_autotuning.py pins the two paths equal
+# on MLP + GPT configs.
+# ---------------------------------------------------------------------------
+
+def _shape_tree_params(param_shapes) -> int:
+    """Total parameter count of a shape tree — leaves need only
+    ``.shape`` (arrays, ShapeDtypeStructs and plain numpy all work)."""
+    import jax
+
+    return int(sum(
+        int(np.prod(l.shape)) if getattr(l, "shape", ()) else 1
+        for l in jax.tree_util.tree_leaves(param_shapes)))
+
+
+def optimizer_state_full_bytes(optimizer_name, optimizer_params,
+                               total_params: int) -> int:
+    """Full-tree optimizer-state bytes for a config-named optimizer — the
+    closed form of ``_tree_full_bytes(optimizer.init(master))``: Adam/
+    AdamW/LAMB carry two fp32 moment trees plus an int32 step scalar;
+    SGD carries one fp32 momentum tree (or a bare int32 scalar when
+    momentum is 0). Unknown/absent names take the Adam shape — the
+    engine's own default (_configure_basic_optimizer)."""
+    name = str(optimizer_name or "adam").lower()
+    if name == "sgd":
+        momentum = float((optimizer_params or {}).get("momentum", 0.0))
+        return 4 * total_params if momentum else 4
+    # adam / adamw / lamb / cpuadam / unknown: AdamState-shaped
+    return 8 * total_params + 4
+
+
+def state_totals_from_shapes(param_shapes, *, optimizer_name=None,
+                             optimizer_params=None,
+                             precision_dtype: str = "float32",
+                             grad_accum_dtype: str = "float32"
+                             ) -> Dict[str, int]:
+    """The ledger's ``full`` component totals from a parameter-shape tree
+    + config dtypes alone — exactly what :func:`model_state_ledger`
+    computes from a live engine's state trees (mixed precision adds the
+    compute-dtype copy; a pure-fp32 run has none: the master IS the
+    compute tree)."""
+    total = _shape_tree_params(param_shapes)
+    mixed = str(precision_dtype) != "float32"
+    # bf16/fp16 are 2 bytes; resolved by name so the function stays
+    # importable without ml_dtypes' numpy registrations.
+    compute_itemsize = (2 if str(precision_dtype) in
+                        ("bfloat16", "bf16", "float16", "fp16") else 4)
+    acc_itemsize = (2 if str(grad_accum_dtype) in ("bfloat16", "bf16")
+                    else 4)
+    return {
+        "total_params": total,
+        "master_bytes": 4 * total,
+        "optimizer_bytes": int(optimizer_state_full_bytes(
+            optimizer_name, optimizer_params, total)),
+        "grads_bytes": acc_itemsize * total,
+        "compute_params_bytes": (compute_itemsize * total if mixed else 0),
+    }
+
+
+def plan_capacity_from_config(config, param_shapes, *,
+                              num_shards: Optional[int] = None,
+                              microbatch: Optional[int] = None,
+                              act_bytes_per_sample: Optional[float] = None,
+                              hbm_limit_bytes: Optional[float] = None
+                              ) -> Dict[str, Any]:
+    """:func:`plan_capacity` driven from a parsed ``DeepSpeedTPUConfig``
+    + a parameter-shape tree — no engine, no devices, no placement. The
+    same arithmetic as the engine path (``MemoryObservatory.
+    _plan_from_engine``), including its offload-row compute fallback;
+    ``num_shards`` defaults to the config's data-parallel size (the
+    engine path uses the mesh's ICI-inner ``data`` axis — pass it when a
+    multi-slice mesh narrows the ZeRO shard axis below dp)."""
+    totals = state_totals_from_shapes(
+        param_shapes,
+        optimizer_name=getattr(config, "optimizer_name", None),
+        optimizer_params=getattr(config, "optimizer_params", None),
+        precision_dtype=config.precision_dtype,
+        grad_accum_dtype=getattr(config, "grad_accum_dtype", "float32"))
+    mo = totals["master_bytes"] + totals["optimizer_bytes"]
+    return plan_capacity(
+        compute_params_bytes=totals["compute_params_bytes"],
+        offload_compute_params_bytes=(totals["compute_params_bytes"]
+                                      or totals["master_bytes"]),
+        grads_bytes=totals["grads_bytes"],
+        master_optim_bytes=mo,
+        num_shards=(int(num_shards) if num_shards is not None
+                    else int(config.data_parallel_size
+                             // max(config.mesh.slices, 1))),
+        microbatch=(int(microbatch) if microbatch is not None
+                    else int(config.train_micro_batch_size_per_gpu)),
+        act_bytes_per_sample=float(
+            act_bytes_per_sample
+            if act_bytes_per_sample is not None
+            else config.telemetry.memory.activation_bytes_per_sample),
+        hbm_limit_bytes=hbm_limit_bytes,
+        chosen_stage=int(config.zero_config.stage),
+        chosen_offload=bool(config.zero_config.offload_optimizer.enabled),
+        total_params=totals["total_params"])
+
+
 def _gb(v) -> str:
     return f"{v / 1024**3:8.3f}" if v is not None else "     n/a"
 

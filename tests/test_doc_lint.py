@@ -16,6 +16,7 @@ for tier-1.
 import os
 import re
 
+from deepspeed_tpu.autotuning.search import AUTOTUNE_METRIC_TAGS
 from deepspeed_tpu.comm.grad_sync import COMM_PARAM_METRIC_TAGS
 from deepspeed_tpu.resilience.elastic import ELASTIC_METRIC_TAGS
 from deepspeed_tpu.serving.engine import SERVING_METRIC_TAGS
@@ -41,6 +42,8 @@ _NUMERICS_TOKEN_RE = re.compile(r"numerics/[A-Za-z_]+")
 _COMM_PARAMS_TOKEN_RE = re.compile(r"comm/[A-Za-z_]+_params")
 # \b so "elasticity/" (the package path) never false-positives
 _ELASTIC_TOKEN_RE = re.compile(r"\belastic/[A-Za-z_]+")
+# \b so "autotuning/" (the package path) never false-positives
+_AUTOTUNE_TOKEN_RE = re.compile(r"\bautotune/[A-Za-z_]+")
 
 
 def _iter_py_files():
@@ -242,6 +245,48 @@ class TestDocDrift:
         assert emitted, "the scan must see the numerics emissions"
         assert emitted <= NUMERICS_METRIC_TAGS, (
             emitted - NUMERICS_METRIC_TAGS)
+
+    def test_autotune_tags_documented_and_vice_versa(self):
+        """The autotuner surface (autotuning/search.py) is pinned in BOTH
+        directions like goodput/fleet/memory: every tag the search can
+        emit — the autotune/* gauges plus the adoption instant — must be
+        in the doc, and every autotune/* token the doc names must be one
+        the code emits."""
+        doc = _doc_text()
+        undocumented = sorted(t for t in AUTOTUNE_METRIC_TAGS
+                              if t not in doc)
+        assert not undocumented, undocumented
+        doc_tokens = set(_AUTOTUNE_TOKEN_RE.findall(doc))
+        phantom = sorted(t for t in doc_tokens
+                         if t not in AUTOTUNE_METRIC_TAGS)
+        assert not phantom, (
+            f"docs/OBSERVABILITY.md names autotune tags the code never "
+            f"emits: {phantom}")
+        # every literal autotune/* emission in the tree is a declared tag
+        emitted = {t for _, _, t in _emitted_literals()
+                   if t.startswith("autotune/")}
+        assert emitted, "the scan must see the autotune gauge emissions"
+        assert emitted <= AUTOTUNE_METRIC_TAGS, (
+            emitted - AUTOTUNE_METRIC_TAGS)
+        # the search-window wall-clock category rides the goodput
+        # enforcement
+        assert "goodput/autotune_search_sec" in GOODPUT_METRIC_TAGS
+        assert "goodput/autotune_search_sec" in doc
+
+    def test_autotune_report_tags_in_sync(self):
+        """tools/autotune_report.py is stdlib-only by design (no package
+        import), so its private tag tuple is pinned here instead — every
+        autotune/* literal the report reads must be one the search
+        emits."""
+        with open(os.path.join(REPO, "tools", "autotune_report.py")) as f:
+            src = f.read()
+        report_tags = set(re.findall(r'"(autotune/[A-Za-z_]+)"', src))
+        assert report_tags, "scan must see autotune_report's tags"
+        phantom = sorted(t for t in report_tags
+                         if t not in AUTOTUNE_METRIC_TAGS)
+        assert not phantom, (
+            f"tools/autotune_report.py reads tags the code never emits: "
+            f"{phantom} — keep it in sync with autotuning/search.py")
 
     def test_numerics_report_tags_in_sync(self):
         """tools/numerics_report.py is stdlib-only by design (no package

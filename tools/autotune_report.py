@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Autotune search report — stdlib-only, like the other report tools.
+
+Renders ``autotune_result*.json`` (autotuning/search.py) into the
+per-candidate verdict table: status, projected HBM, modeled cost,
+measured step time, and the prune/elimination reason — plus the adopted
+config's knobs and, when a metrics JSONL sits beside the result, the
+``autotune/*`` gauges the search emitted.
+
+Usage:
+  python tools/autotune_report.py <run_dir | autotune_result.json>
+  python tools/autotune_report.py --selftest
+"""
+
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+# Tags this report reads — pinned against autotuning/search.py's
+# AUTOTUNE_METRIC_TAGS by tests/test_doc_lint.py (this file is
+# deliberately import-free of the package, the report-tool rule).
+GAUGES = ("autotune/candidates", "autotune/pruned", "autotune/trials",
+          "autotune/search_sec", "autotune/best_step_ms")
+
+
+def find_results(path: str) -> List[str]:
+    if os.path.isfile(path):
+        return [path]
+    return sorted(glob.glob(os.path.join(path, "autotune_result*.json")))
+
+
+def _gb(v: Optional[float]) -> str:
+    return f"{v / 1024**3:8.3f}" if v is not None else "     n/a"
+
+
+def _ms(v: Optional[float]) -> str:
+    return f"{v:9.2f}" if v is not None else "      n/a"
+
+
+def render(doc: Dict[str, Any], source: str = "") -> str:
+    lines = []
+    adopted = doc.get("adopted", {})
+    lines.append(
+        f"autotune result{f' ({source})' if source else ''}: world "
+        f"{doc.get('world_size')}, {len(doc.get('candidates', []))} "
+        f"candidates, search {doc.get('search_sec', 0):.1f}s")
+    limit = doc.get("hbm_limit_bytes")
+    lines.append(
+        f"  HBM limit: {_gb(limit).strip()} GB"
+        + (f" (headroom_frac {doc.get('headroom_frac')})" if limit
+           else " (unknown — capacity pruning inactive)"))
+    lines.append(
+        f"  adopted: '{adopted.get('name')}' at "
+        f"{adopted.get('measured_step_ms')} ms/step "
+        f"(default measured {doc.get('default_measured_step_ms')} ms), "
+        f"config hash {adopted.get('config_hash')}")
+    if adopted.get("overrides"):
+        lines.append(f"  adopted overrides: "
+                     f"{json.dumps(adopted['overrides'], sort_keys=True)}")
+    header = (f"  {'candidate':<28} {'status':<16} {'proj GB':>8} "
+              f"{'meas ms':>9}  reason")
+    lines.append(header)
+    lines.append("  " + "-" * (len(header) - 2))
+    for r in doc.get("candidates", []):
+        lines.append(
+            f"  {r.get('name', '?'):<28} {r.get('status', '?'):<16} "
+            f"{_gb(r.get('projected_device_bytes'))} "
+            f"{_ms(r.get('measured_step_ms'))}  {r.get('reason') or ''}")
+    for n in doc.get("notes", []):
+        lines.append(f"  note: {n}")
+    return "\n".join(lines)
+
+
+def render_metrics(run_dir: str) -> str:
+    """The autotune/* gauge values from any metrics*.jsonl beside the
+    result (best-effort; absent file renders nothing)."""
+    rows = {}
+    for path in sorted(glob.glob(os.path.join(run_dir, "metrics*.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    name = rec.get("name", "")
+                    if name in GAUGES:
+                        rows[name] = rec.get("value")
+        except OSError:
+            continue
+    if not rows:
+        return ""
+    return "\n".join([f"  {k}: {v}" for k, v in sorted(rows.items())])
+
+
+def selftest() -> int:
+    doc = {
+        "format": 1, "world_size": 8, "search_sec": 3.2,
+        "hbm_limit_bytes": 2 * 1024**3, "headroom_frac": 0.9,
+        "default_measured_step_ms": 12.5,
+        "adopted": {"name": "stage3-mb2x4", "measured_step_ms": 9.8,
+                    "config_hash": "abc123", "overrides": {"zero_stage": 3}},
+        "candidates": [
+            {"name": "default", "status": "trialed",
+             "projected_device_bytes": 1024**3, "measured_step_ms": 12.5,
+             "reason": None},
+            {"name": "stage3-mb2x4", "status": "adopted",
+             "projected_device_bytes": 512 * 1024**2,
+             "measured_step_ms": 9.8, "reason": None},
+            {"name": "stage0-mb8x1", "status": "pruned_capacity",
+             "projected_device_bytes": 4 * 1024**3,
+             "measured_step_ms": None,
+             "reason": "capacity: projects 4.00 GB per device > 90% of "
+                       "the 2.00 GB HBM limit"},
+        ],
+        "notes": ["comm axes collapsed: single-slice mesh (dcn=1) has no "
+                  "DCN hop to tune"],
+    }
+    text = render(doc, source="selftest")
+    print(text)
+    assert "adopted: 'stage3-mb2x4' at 9.8 ms/step" in text
+    assert "pruned_capacity" in text and "4.00 GB" in text
+    assert "default" in text and "12.50" in text
+    assert "note: comm axes collapsed" in text
+    print("selftest ok")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in argv:
+        return selftest()
+    if not argv:
+        print(__doc__)
+        return 2
+    path = argv[0]
+    results = find_results(path)
+    if not results:
+        print(f"no autotune_result*.json under {path!r}", file=sys.stderr)
+        return 1
+    for rp in results:
+        with open(rp) as f:
+            doc = json.load(f)
+        print(render(doc, source=os.path.basename(rp)))
+        if os.path.isdir(path):
+            metrics = render_metrics(path)
+            if metrics:
+                print("  gauges:")
+                print(metrics)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

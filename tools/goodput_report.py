@@ -52,6 +52,7 @@ CATEGORIES = (
     "recompile",
     "init_restore",
     "elastic_reshard",
+    "autotune_search",
     "idle_other",
 )
 
