@@ -948,6 +948,14 @@ class ServingConfig:
     bounds how long the decode batch waits on prompt processing.
     ``temperature``/``top_k``/``seed``: engine-wide sampling policy
     (0.0 = greedy, byte-reproducible).
+
+    Decode fast path (docs/SERVING.md "Decode fast path" — all three
+    off by default, PR-8 bit-identical): ``decode_attention``
+    gather|auto|kernel selects the Pallas paged decode-attention kernel
+    (with the max-active-length-capped gather as its fallback);
+    ``prefix_cache`` turns on COW prompt-head block reuse;
+    ``speculative`` configures draft-model speculative decoding
+    (greedy-identical by construction — requires ``temperature == 0``).
     """
 
     max_batch_size: int = C.SERVING_MAX_BATCH_SIZE_DEFAULT
@@ -960,6 +968,11 @@ class ServingConfig:
     temperature: float = C.SERVING_TEMPERATURE_DEFAULT
     top_k: int = C.SERVING_TOP_K_DEFAULT
     seed: int = C.SERVING_SEED_DEFAULT
+    decode_attention: str = C.SERVING_DECODE_ATTENTION_DEFAULT
+    prefix_cache: bool = C.SERVING_PREFIX_CACHE_DEFAULT
+    spec_decode: bool = C.SERVING_SPEC_ENABLED_DEFAULT
+    spec_k: int = C.SERVING_SPEC_K_DEFAULT
+    spec_draft_layers: Optional[int] = None
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -986,7 +999,22 @@ class ServingConfig:
                                    C.SERVING_TEMPERATURE_DEFAULT)),
             top_k=int(_get(d, C.SERVING_TOP_K, C.SERVING_TOP_K_DEFAULT)),
             seed=int(_get(d, C.SERVING_SEED, C.SERVING_SEED_DEFAULT)),
+            decode_attention=str(_get(
+                d, C.SERVING_DECODE_ATTENTION,
+                C.SERVING_DECODE_ATTENTION_DEFAULT)),
+            prefix_cache=bool(_get(d, C.SERVING_PREFIX_CACHE,
+                                   C.SERVING_PREFIX_CACHE_DEFAULT)),
         )
+        spec = d.get(C.SERVING_SPECULATIVE) or {}
+        if not isinstance(spec, dict):
+            raise ConfigError("serving.speculative must be a dict")
+        cfg.spec_decode = bool(spec.get(C.SERVING_SPEC_ENABLED,
+                                        C.SERVING_SPEC_ENABLED_DEFAULT))
+        cfg.spec_k = int(spec.get(C.SERVING_SPEC_K,
+                                  C.SERVING_SPEC_K_DEFAULT))
+        cfg.spec_draft_layers = (
+            int(spec[C.SERVING_SPEC_DRAFT_LAYERS])
+            if spec.get(C.SERVING_SPEC_DRAFT_LAYERS) is not None else None)
         if cfg.max_batch_size < 1:
             raise ConfigError("serving.max_batch_size must be >= 1")
         if cfg.kv_block_size < 1:
@@ -1003,6 +1031,21 @@ class ServingConfig:
             raise ConfigError("serving.temperature must be >= 0")
         if cfg.top_k < 0:
             raise ConfigError("serving.top_k must be >= 0")
+        if cfg.decode_attention not in C.SERVING_DECODE_ATTENTION_CHOICES:
+            raise ConfigError(
+                f"serving.decode_attention must be one of "
+                f"{C.SERVING_DECODE_ATTENTION_CHOICES}, got "
+                f"{cfg.decode_attention!r}")
+        if cfg.spec_k < 1:
+            raise ConfigError("serving.speculative.k must be >= 1")
+        if cfg.spec_draft_layers is not None and cfg.spec_draft_layers < 1:
+            raise ConfigError(
+                "serving.speculative.draft_layers must be >= 1")
+        if cfg.spec_decode and cfg.temperature != 0.0:
+            raise ConfigError(
+                "serving.speculative requires temperature == 0 (greedy): "
+                "the accept/rollback contract is token-identity with "
+                "greedy decode")
         return cfg
 
 

@@ -279,6 +279,25 @@ SERVING_TOP_K = "top_k"
 SERVING_TOP_K_DEFAULT = 0
 SERVING_SEED = "seed"
 SERVING_SEED_DEFAULT = 0
+# decode fast path (docs/SERVING.md "Decode fast path"): "gather" keeps
+# the PR-8 full-window gather program bit-identical; "auto" runs the
+# Pallas paged decode-attention kernel where the geometry tiles and the
+# max-active-length-capped gather elsewhere; "kernel" forces the kernel
+# (Pallas interpreter off-TPU — the parity/bench path).
+SERVING_DECODE_ATTENTION = "decode_attention"
+SERVING_DECODE_ATTENTION_DEFAULT = "gather"
+SERVING_DECODE_ATTENTION_CHOICES = ("gather", "auto", "kernel")
+# prefix-cache reuse: ref-counted prompt-head trie over KV blocks —
+# warm heads skip the shared portion of prefill (COW adoption).
+SERVING_PREFIX_CACHE = "prefix_cache"
+SERVING_PREFIX_CACHE_DEFAULT = False
+# speculative decoding sub-block
+SERVING_SPECULATIVE = "speculative"
+SERVING_SPEC_ENABLED = "enabled"
+SERVING_SPEC_ENABLED_DEFAULT = False
+SERVING_SPEC_K = "k"                      # draft tokens proposed per round
+SERVING_SPEC_K_DEFAULT = 4
+SERVING_SPEC_DRAFT_LAYERS = "draft_layers"  # None -> num_layers // 2
 
 #############################################
 # Logging / misc

@@ -43,8 +43,18 @@ def collect_report() -> dict:
         report["platform"] = f"unavailable ({e})"
 
     on_tpu = report["platform"] == "tpu"
+    # Enumerated, not a single boolean: which Pallas kernels are LIVE in
+    # this environment (compiled on TPU; all of them run through the
+    # interpreter for off-TPU parity tests, which is not "live").
+    report["pallas_kernels"] = {
+        "flash_attention": on_tpu,
+        "sparse_attention": on_tpu,
+        "paged_decode_attention": on_tpu,
+    }
     report["features"] = {
-        "pallas_kernels (flash/sparse attention)": on_tpu,
+        "pallas_kernels": ", ".join(
+            k for k, ok in report["pallas_kernels"].items() if ok)
+        or "none (interpret-only off TPU)",
         "xla_reference_ops": report["packages"]["jax"] is not None,
         "multihost (jax.distributed)": report["packages"]["jax"] is not None,
         "zero_stages_0_3": True,
@@ -75,6 +85,11 @@ def main():
     print("-" * 60)
     print("feature availability")
     for feat, ok in report["features"].items():
+        if feat == "pallas_kernels":
+            live = [k for k, on in report["pallas_kernels"].items() if on]
+            mark = GREEN_OK if live else RED_NO
+            print(f"  {mark} pallas_kernels: {ok}")
+            continue
         print(f"  {GREEN_OK if ok else RED_NO} {feat}")
     print("-" * 60)
     print("op registry (op_builder analogue)")

@@ -217,6 +217,20 @@ class GPTBlock(nn.Module):
                 qpos = pos + jnp.arange(s)
                 kpos = jnp.arange(ck.shape[1])
                 dec_mask = (kpos[None, :] <= qpos[:, None])[None, None]
+                if attn_mask is not None:
+                    dec_mask = jnp.logical_and(dec_mask, attn_mask)
+                o = attention(q, ck, cv, causal=False, mask=dec_mask,
+                              deterministic=True, impl="xla",
+                              softmax_scale=cfg.attention_scale)
+            elif (getattr(kv_cache, "attn_impl", "gather") == "kernel"
+                    and attn_mask is None):
+                # Paged decode fast path: the Pallas kernel streams K/V
+                # blocks from the pool through the block table (int8
+                # pools dequantized in-kernel) — the gathered [B, L, H,
+                # D] copy is never materialized. Same visibility
+                # semantics as the gather branch below (parity-tested).
+                kv_cache, o = kv_cache.update_attend(
+                    q, k, v, softmax_scale=cfg.attention_scale)
             else:
                 # Paged decode (serving/kv_cache.py): the cache object
                 # scatters this chunk through its block table at per-ROW
@@ -225,11 +239,11 @@ class GPTBlock(nn.Module):
                 # batch sit at different sequence lengths, so the scalar
                 # ``pos`` is unused here.
                 kv_cache, ck, cv, dec_mask = kv_cache.update(k, v)
-            if attn_mask is not None:
-                dec_mask = jnp.logical_and(dec_mask, attn_mask)
-            o = attention(q, ck, cv, causal=False, mask=dec_mask,
-                          deterministic=True, impl="xla",
-                          softmax_scale=cfg.attention_scale)
+                if attn_mask is not None:
+                    dec_mask = jnp.logical_and(dec_mask, attn_mask)
+                o = attention(q, ck, cv, causal=False, mask=dec_mask,
+                              deterministic=True, impl="xla",
+                              softmax_scale=cfg.attention_scale)
         elif cfg.sparse_attention is not None:
             # Config-driven block-sparse path (reference
             # sparse_attention_utils.py model surgery). Attention-prob

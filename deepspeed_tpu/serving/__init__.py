@@ -12,10 +12,11 @@ entry point.
 from deepspeed_tpu.serving.engine import SERVING_METRIC_TAGS, ServeEngine
 from deepspeed_tpu.serving.kv_cache import (BlockPool, PagedLayerCache,
                                             init_paged_pools, pack_prefill)
-from deepspeed_tpu.serving.scheduler import Request, Scheduler, Sequence
+from deepspeed_tpu.serving.scheduler import (PrefixCache, Request,
+                                             Scheduler, Sequence)
 
 __all__ = [
-    "BlockPool", "PagedLayerCache", "Request", "SERVING_METRIC_TAGS",
-    "ServeEngine", "Scheduler", "Sequence", "init_paged_pools",
-    "pack_prefill",
+    "BlockPool", "PagedLayerCache", "PrefixCache", "Request",
+    "SERVING_METRIC_TAGS", "ServeEngine", "Scheduler", "Sequence",
+    "init_paged_pools", "pack_prefill",
 ]

@@ -40,7 +40,8 @@ from deepspeed_tpu.inference.engine import (InferenceEngine, bucket_length,
                                             sample_logits)
 from deepspeed_tpu.serving.kv_cache import (BlockPool, PagedLayerCache,
                                             init_paged_pools, pack_prefill)
-from deepspeed_tpu.serving.scheduler import Scheduler, Sequence
+from deepspeed_tpu.serving.scheduler import (PrefixCache, Scheduler,
+                                             Sequence)
 from deepspeed_tpu.utils.logging import log_dist
 
 # Every metric tag the serving engine can emit — pinned against
@@ -53,6 +54,13 @@ SERVING_METRIC_TAGS = frozenset({
     "serving/queue_depth",
     "serving/preempted_seqs",
     "serving/requests_completed",
+    # decode fast path (docs/SERVING.md "Decode fast path"): per-piece
+    # attribution so each win is separately measurable.
+    "serving/decode_attn_kernel",
+    "serving/prefix_hits",
+    "serving/prefix_blocks_reused",
+    "serving/spec_accept_rate",
+    "serving/spec_tokens_per_verify",
 })
 
 
@@ -104,7 +112,10 @@ class ServeEngine:
                 f"({model_max}) — no prompt bucket fits")
 
         self.pool = BlockPool(self.scfg.kv_num_blocks)
-        self.sched = Scheduler(self.scfg.max_batch_size, self.pool, bs)
+        self.prefix_cache = (PrefixCache(self.pool, bs)
+                             if self.scfg.prefix_cache else None)
+        self.sched = Scheduler(self.scfg.max_batch_size, self.pool, bs,
+                               prefix_cache=self.prefix_cache)
         self._dtype = engine.config.dtype
         self._dtype_name = jnp.dtype(self._dtype).name
         self._pools = init_paged_pools(
@@ -112,7 +123,35 @@ class ServeEngine:
             int8=self.scfg.int8_kv_cache, dtype=self._dtype)
 
         self._prefill_jit: Dict[int, Any] = {}
-        self._decode_jit = None
+        # -- decode fast path (docs/SERVING.md "Decode fast path") ------
+        # "gather" (default) keeps the PR-8 program byte-for-byte: one
+        # decode program over the FULL table window, no window slicing,
+        # no kernel. "auto"/"kernel" turn on window capping (the decode
+        # key axis covers only the max active length, ceiled to a
+        # power-of-two block count — O(log max_blocks) compiled variants
+        # instead of one) and, where the geometry tiles (or always,
+        # under "kernel" — the Pallas interpreter covers CPU), the paged
+        # decode-attention kernel.
+        from deepspeed_tpu.ops.transformer.paged_attention import \
+            paged_decode_ok
+        mode = self.scfg.decode_attention
+        self._fast_path = mode != "gather"
+        if mode == "kernel":
+            self._attn_impl = "kernel"
+        elif mode == "auto":
+            on_tpu = jax.devices()[0].platform == "tpu"
+            self._attn_impl = (
+                "kernel" if on_tpu and paged_decode_ok(
+                    self.model_cfg.head_dim, bs) else "gather")
+        else:
+            self._attn_impl = "gather"
+        self._decode_jits: Dict[Any, Any] = {}    # window bucket -> jit
+        self._tail_prefill_jit: Dict[int, Any] = {}
+        # -- speculative decoding ---------------------------------------
+        self._spec_k = 0
+        self._spec_jits: Dict[Any, Any] = {}
+        if self.scfg.spec_decode:
+            self._init_speculative()
         # Numerics observatory surface (telemetry/numerics.py): with the
         # int8 KV cache AND the numerics opt-in on
         # (``telemetry.numerics.enabled`` — init_serving plumbs it;
@@ -143,8 +182,16 @@ class ServeEngine:
         self.results: Dict[int, Dict[str, Any]] = {}
         # Host-side aggregates, kept regardless of telemetry (floats and
         # ints only — the SLO gauges are derived from these).
+        # ``gathered_positions``: cumulative key positions the decode
+        # program touched per row (window width x steps) — the modeled
+        # HBM-traffic evidence behind the capped fallback
+        # (tools/probe_serving_fastpath.py); ``full_positions`` is the
+        # uncapped counterfactual.
         self.stats = {"decode_steps": 0, "occupancy_sum": 0.0,
-                      "slot_assignments": {}}
+                      "slot_assignments": {}, "kernel_steps": 0,
+                      "gathered_positions": 0, "full_positions": 0,
+                      "spec_rounds": 0, "spec_proposed": 0,
+                      "spec_accepted": 0, "spec_new_tokens": 0}
         log_dist(
             f"serving: {self.scfg.max_batch_size} slots, KV pool "
             f"{self.pool.capacity}x{bs} positions "
@@ -207,6 +254,7 @@ class ServeEngine:
             if seq is None:
                 break
             self._prefill(seq)
+            self.sched.register_prefix(seq, self._step_count)
             info["prefilled"].append(seq.request.rid)
             self.stats["slot_assignments"].setdefault(seq.slot, 0)
             self.stats["slot_assignments"][seq.slot] += 1
@@ -214,31 +262,39 @@ class ServeEngine:
                 self._finish(seq, info)
 
         # -- decode one token for every running sequence ----------------
+        # (a speculative round writes k+1 positions, so capacity is
+        # ensured with that lookahead — capped at each row's lifetime)
         active = self.sched.active
         for seq in list(active):
             if self.sched.running.get(seq.slot) is seq:
-                self.sched.ensure_capacity(seq)
+                self.sched.ensure_capacity(seq, lookahead=self._spec_k)
         active = self.sched.active          # preemption may have evicted
         info["active"] = len(active)
         dt_decode = 0.0
+        n_tokens = 0
         if active:
             t_dec = time.perf_counter()
-            toks, logits = self._decode(active)
-            dt_decode = time.perf_counter() - t_dec
-            for seq, tok in zip(active, toks):
-                seq.tokens.append(int(tok))
-                seq.pos += 1
-                if seq.finished():
-                    self._finish(seq, info)
-            if self.capture_logits:
-                info["logits"] = logits
-                info["slots"] = {s.slot: s.request.rid for s in active}
+            if self._spec_k:
+                n_tokens = self._spec_round(active, info)
+                dt_decode = time.perf_counter() - t_dec
+            else:
+                toks, logits = self._decode(active)
+                dt_decode = time.perf_counter() - t_dec
+                n_tokens = len(active)
+                for seq, tok in zip(active, toks):
+                    seq.tokens.append(int(tok))
+                    seq.pos += 1
+                    if seq.finished():
+                        self._finish(seq, info)
+                if self.capture_logits:
+                    info["logits"] = logits
+                    info["slots"] = {s.slot: s.request.rid for s in active}
             self.stats["decode_steps"] += 1
             self.stats["occupancy_sum"] += \
                 len(active) / self.scfg.max_batch_size
         # Gauges carry the SAME step index as this iteration's TTFT/
         # completion rows (emitted above) — increment only afterwards.
-        self._emit_step_metrics(len(active), dt_decode)
+        self._emit_step_metrics(len(active), dt_decode, n_tokens)
         self._step_count += 1
         return info
 
@@ -303,6 +359,13 @@ class ServeEngine:
 
     # -- prefill --------------------------------------------------------
     def _prefill(self, seq: Sequence) -> None:
+        if seq.shared_len:
+            # Warm prompt head (prefix cache hit): the adopted blocks
+            # already hold positions [0, shared_len) — only the tail is
+            # computed, through the paged cache (TTFT collapses to the
+            # unshared remainder).
+            self._prefill_tail(seq)
+            return
         t = len(seq.request.prompt)
         bucket = seq.bucket
         ids = np.zeros((1, bucket), np.int32)
@@ -327,17 +390,80 @@ class ServeEngine:
             blocks = jnp.asarray(seq.block_table, jnp.int32)
             self._pools = self._pack_jit(self._pools, blocks, ks, vs)
             first = int(tok)                     # host fetch = first token
+        self._record_first_token(seq, first)
+
+    def _prefill_tail(self, seq: Sequence) -> None:
+        """Prefill only the unshared prompt tail through the paged cache:
+        the tail chunk (right-padded to a block-multiple bucket) runs one
+        multi-token paged forward at per-row position ``shared_len`` —
+        writes land past the adopted (immutable) head blocks, attention
+        sees head + causal tail, and the first token samples from the
+        last REAL tail position. The int8 KV quant-error gauge is NOT
+        measured here: the adopted head blocks were measured at their
+        cold prefill, and the tail's K/V never leave the jitted program
+        as stacks (docs/SERVING.md "Current limits")."""
+        t = len(seq.request.prompt)
+        sl = seq.shared_len
+        tail = t - sl                           # >= 1 (match is capped)
+        mb_positions = self.max_blocks * self.block_size
+        tb = min(self._bucket_of(tail), mb_positions - sl)
+        ids = np.zeros((1, tb), np.int32)
+        ids[0, :tail] = seq.request.prompt[sl:]
+        bt = np.zeros((1, self.max_blocks), np.int32)
+        bt[0, :len(seq.block_table)] = seq.block_table
+        dev_ids, dev_bt = jnp.asarray(ids), jnp.asarray(bt)
+        start = jnp.asarray([sl], jnp.int32)
+        length = jnp.asarray(tail, jnp.int32)
+        rng = jax.random.fold_in(self._base_key, 2 * seq.request.rid + 1)
+        self.engine.recompile_detector.check(
+            f"serving.prefill_tail_b{tb}", dev_ids, dev_bt, start, length)
+        if tb not in self._tail_prefill_jit:
+            self._tail_prefill_jit[tb] = jax.jit(functools.partial(
+                self._prefill_tail_impl, tail_bucket=tb),
+                donate_argnums=(1,))
+        with self.telemetry.span("prefill", rid=seq.request.rid,
+                                 bucket=tb, prompt_len=t, shared_len=sl):
+            tok, self._pools = self._tail_prefill_jit[tb](
+                self.engine.params, self._pools, dev_ids, dev_bt, start,
+                length, rng)
+            first = int(tok)                     # host fetch = first token
+        self._record_first_token(seq, first)
+
+    def _record_first_token(self, seq: Sequence, first: int) -> None:
+        """Append the prefill's sampled token and record TTFT — on the
+        request's FIRST prefill only: a preemption restart (cold or
+        warm) must not add a second (optimistically small) TTFT
+        observation."""
         now = time.monotonic()
         seq.tokens.append(first)
         if seq.request.first_token_time is None:
-            # First prefill only: a preemption restart must not add a
-            # second (and optimistically small) TTFT observation.
             seq.request.first_token_time = now
             if self.telemetry.enabled:
                 self.telemetry.registry.histogram(
                     "serving/ttft_ms").observe(
                     (now - seq.request.arrival) * 1e3,
                     step=self._step_count)
+
+    def _prefill_tail_impl(self, params, pools, ids, bt, start, length,
+                           rng, *, tail_bucket: int):
+        # The tail writes [start, start + tail_bucket) — block-aligned
+        # start, so adopted head blocks are never touched; pad positions
+        # past the allocated blocks hit zero table entries (scratch).
+        cache = tuple(
+            PagedLayerCache(*pools[i], bt, start, self.block_size,
+                            self._dtype_name)
+            for i in range(self.model_cfg.num_layers))
+        pos_ids = jnp.minimum(start[:, None] + jnp.arange(tail_bucket),
+                              self.model_cfg.max_seq_len - 1)
+        out = self.module.apply(
+            {"params": self.engine._materialized(params)},
+            {"input_ids": ids, "position_ids": pos_ids},
+            deterministic=True, cache=cache, pos=None)
+        last = jax.lax.dynamic_index_in_dim(out["logits"], length - 1,
+                                            axis=1, keepdims=False)  # [1,V]
+        tok = sample_logits(last.astype(jnp.float32), rng,
+                            self.scfg.temperature, self.scfg.top_k)[0]
+        return tok, tuple(c.pools for c in out["cache"])
 
     def _prefill_impl(self, params, ids, length, rng, *, bucket: int):
         from deepspeed_tpu.models.gpt import init_kv_cache
@@ -358,9 +484,10 @@ class ServeEngine:
         return tok, last, k_stack, v_stack
 
     # -- decode ---------------------------------------------------------
-    def _decode(self, active: List[Sequence]):
+    def _batch_inputs(self, active: List[Sequence]):
+        """Host-side decode batch matrices (inactive rows -> scratch)."""
         nb, mb = self.scfg.max_batch_size, self.max_blocks
-        bt = np.zeros((nb, mb), np.int32)        # inactive rows -> scratch
+        bt = np.zeros((nb, mb), np.int32)
         pos = np.zeros((nb,), np.int32)
         toks = np.zeros((nb,), np.int32)
         for seq in active:
@@ -368,24 +495,63 @@ class ServeEngine:
             bt[s, :len(seq.block_table)] = seq.block_table
             pos[s] = seq.pos
             toks[s] = seq.tokens[-1]
+        return bt, pos, toks
+
+    def _window_blocks(self, active: List[Sequence], chunk: int) -> int:
+        """Fast-path key-window width: enough table columns to cover the
+        longest active row's reads AND the chunk's writes, ceiled to a
+        power of two — O(log max_blocks) compiled decode variants, each
+        gathering/streaming only what some batch actually needs."""
+        need_pos = max(seq.pos for seq in active) + chunk
+        need = -(-need_pos // self.block_size)
+        wb = 1
+        while wb < need:
+            wb *= 2
+        return min(wb, self.max_blocks)
+
+    def _dispatch_batch(self, active: List[Sequence], chunk: int,
+                        scope: str):
+        """Shared decode/spec dispatch prep: batch matrices, window
+        slicing under the fast path, the detector scope (per window
+        bucket when capped), the jit-cache key, the resolved attention
+        impl, and the gathered-positions evidence — ONE accounting for
+        both paths so they cannot drift."""
+        mb = self.max_blocks
+        bt, pos, toks = self._batch_inputs(active)
+        if self._fast_path:
+            wb = self._window_blocks(active, chunk)
+            bt = bt[:, :wb]
+            key, name, impl = wb, f"{scope}_w{wb}", self._attn_impl
+        else:
+            wb, key, name, impl = mb, None, scope, "gather"
+        self.stats["gathered_positions"] += wb * self.block_size
+        self.stats["full_positions"] += mb * self.block_size
+        if impl == "kernel":
+            self.stats["kernel_steps"] += 1
         bt, pos, toks = jnp.asarray(bt), jnp.asarray(pos), jnp.asarray(toks)
+        self.engine.recompile_detector.check(name, toks, pos, bt)
+        return bt, pos, toks, key, impl
+
+    def _decode(self, active: List[Sequence]):
+        bt, pos, toks, key, impl = self._dispatch_batch(
+            active, 1, "serving.decode_step")
         rng = jax.random.fold_in(self._base_key, 2 * self._step_count)
-        self.engine.recompile_detector.check(
-            "serving.decode_step", toks, pos, bt)
-        if self._decode_jit is None:
-            self._decode_jit = jax.jit(self._decode_impl,
-                                       donate_argnums=(1,))
+        if key not in self._decode_jits:
+            self._decode_jits[key] = jax.jit(
+                functools.partial(self._decode_impl, attn_impl=impl),
+                donate_argnums=(1,))
         with self.telemetry.span("decode_step", active=len(active)):
-            tok_dev, logits, self._pools = self._decode_jit(
+            tok_dev, logits, self._pools = self._decode_jits[key](
                 self.engine.params, self._pools, bt, pos, toks, rng)
             tok_host = np.asarray(tok_dev)       # host fetch: finish checks
         logits_host = np.asarray(logits) if self.capture_logits else None
         return [int(tok_host[s.slot]) for s in active], logits_host
 
-    def _decode_impl(self, params, pools, bt, pos, toks, rng):
+    def _decode_impl(self, params, pools, bt, pos, toks, rng, *,
+                     attn_impl: str = "gather"):
         cache = tuple(
             PagedLayerCache(*pools[i], bt, pos, self.block_size,
-                            self._dtype_name)
+                            self._dtype_name, attn_impl)
             for i in range(self.model_cfg.num_layers))
         out = self.module.apply(
             {"params": self.engine._materialized(params)},
@@ -395,6 +561,142 @@ class ServeEngine:
         tok = sample_logits(logits, rng, self.scfg.temperature,
                             self.scfg.top_k)
         return tok, logits, tuple(c.pools for c in out["cache"])
+
+    # -- speculative decoding -------------------------------------------
+    def _init_speculative(self) -> None:
+        """Draft model = a truncated-layer view of the target (the
+        config-named default): the first ``draft_layers`` blocks plus the
+        shared embeddings/final-LN/head, applied with the SAME params by
+        top-level key. Because the draft's layer stack IS the target's
+        prefix, its per-layer K/V are identical to the target's for the
+        same inputs — so the draft reads and writes the target's own
+        pools for its layers: no second KV cache, no draft prefill, and
+        the verify step's rewrites are bit-identical no-ops for accepted
+        tokens."""
+        from dataclasses import replace as dc_replace
+
+        cfg = self.model_cfg
+        if self.scfg.temperature != 0.0:
+            raise ValueError("speculative decoding requires greedy "
+                             "sampling (serving.temperature == 0)")
+        dl = (self.scfg.spec_draft_layers
+              if self.scfg.spec_draft_layers is not None
+              else max(1, cfg.num_layers // 2))
+        if not 1 <= dl < cfg.num_layers:
+            raise ValueError(
+                f"serving.speculative.draft_layers must be in "
+                f"[1, {cfg.num_layers - 1}] for a {cfg.num_layers}-layer "
+                f"target, got {dl}")
+        self._spec_k = int(self.scfg.spec_k)
+        self._draft_layers = dl
+        self._draft_module = type(self.module)(
+            dc_replace(cfg, num_layers=dl))
+        keys = ["wte", "wpe", "ln_f"] + [f"h_{i}" for i in range(dl)]
+        if not getattr(cfg, "tie_embeddings", True):
+            keys.append("lm_head")
+        self._draft_param_keys = tuple(keys)
+        log_dist(f"serving: speculative decode on — draft = first {dl}/"
+                 f"{cfg.num_layers} layers, k={self._spec_k}", ranks=[0])
+
+    def _spec_round(self, active: List[Sequence],
+                    info: Dict[str, Any]) -> int:
+        """One speculative round for the whole batch: the draft proposes
+        ``k`` tokens (one jitted scan — its writes land in the shared
+        pools), ONE target verification scores all ``k+1`` positions
+        through the paged cache, and the standard greedy accept rule
+        keeps outputs token-identical to non-speculative decode: a draft
+        token is kept iff it equals the target's greedy choice at that
+        position, and the first disagreement is replaced by the target's
+        own token. Rejected positions simply stay behind the write
+        cursor (``seq.pos``) — masked now, overwritten by the next
+        round's chunk. Returns the number of tokens appended."""
+        if self.capture_logits:
+            raise ValueError(
+                "capture_logits is not supported with speculative "
+                "decoding — a spec round has no single per-step logits "
+                "row to expose (docs/SERVING.md)")
+        k = self._spec_k
+        bt, pos, toks, key, impl = self._dispatch_batch(
+            active, k + 1, "serving.spec_step")
+        if key not in self._spec_jits:
+            self._spec_jits[key] = jax.jit(
+                functools.partial(self._spec_impl, k=k, attn_impl=impl),
+                donate_argnums=(1,))
+        with self.telemetry.span("spec_step", active=len(active), k=k):
+            chunk_dev, greedy_dev, self._pools = self._spec_jits[key](
+                self.engine.params, self._pools, bt, pos, toks)
+            chunk = np.asarray(chunk_dev)        # [B, k+1] verify inputs
+            greedy = np.asarray(greedy_dev)      # [B, k+1] target argmax
+        appended = 0
+        for seq in active:
+            s = seq.slot
+            drafted = chunk[s, 1:]               # d_1..d_k
+            target = greedy[s]                   # g_1..g_{k+1}
+            accept = 0
+            while accept < k and int(drafted[accept]) == int(target[accept]):
+                accept += 1
+            self.stats["spec_proposed"] += k
+            self.stats["spec_accepted"] += accept
+            # d_1..d_a are the target's own greedy tokens (they matched);
+            # g_{a+1} is the correction/bonus — every appended token is
+            # exactly what greedy non-speculative decode would emit.
+            for tok in list(drafted[:accept]) + [target[accept]]:
+                seq.tokens.append(int(tok))
+                seq.pos += 1
+                appended += 1
+                if seq.finished():
+                    self._finish(seq, info)
+                    break
+        self.stats["spec_rounds"] += 1
+        self.stats["spec_new_tokens"] += appended
+        return appended
+
+    def _spec_impl(self, params, pools, bt, pos, toks, *, k: int,
+                   attn_impl: str):
+        """Draft scan (k+1 single-token steps — the extra step pre-writes
+        the full-accept position so the draft cache never lags) + ONE
+        multi-query target verification over the chunk ``[t0, d_1..d_k]``
+        at positions ``pos..pos+k``. Writes are clamp-guarded: lookahead
+        past a row's allocated blocks lands in scratch."""
+        p = self.engine._materialized(params)
+        dp = {key: p[key] for key in self._draft_param_keys}
+        dl = self._draft_layers
+        nl = self.model_cfg.num_layers
+        bs = self.block_size
+        max_pos = self.model_cfg.max_seq_len - 1
+
+        def draft_step(carry, j):
+            pools_c, cur = carry
+            cache = tuple(
+                PagedLayerCache(*pools_c[i], bt, pos + j, bs,
+                                self._dtype_name, attn_impl,
+                                clamp_writes=True)
+                for i in range(dl))
+            out = self._draft_module.apply(
+                {"params": dp},
+                {"input_ids": cur[:, None],
+                 "position_ids": jnp.minimum(pos + j, max_pos)[:, None]},
+                deterministic=True, cache=cache, pos=None)
+            nxt = jnp.argmax(out["logits"][:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            new_pools = tuple(out["cache"][i].pools if i < dl else pools_c[i]
+                              for i in range(nl))
+            return (new_pools, nxt), cur
+
+        (pools, _), inputs = jax.lax.scan(draft_step, (pools, toks),
+                                          jnp.arange(k + 1))
+        chunk = inputs.T                              # [B, k+1] t0,d_1..d_k
+        pos_ids = jnp.minimum(pos[:, None] + jnp.arange(k + 1), max_pos)
+        cache = tuple(
+            PagedLayerCache(*pools[i], bt, pos, bs, self._dtype_name,
+                            attn_impl, clamp_writes=True)
+            for i in range(nl))
+        out = self.module.apply(
+            {"params": p}, {"input_ids": chunk, "position_ids": pos_ids},
+            deterministic=True, cache=cache, pos=None)
+        greedy = jnp.argmax(out["logits"].astype(jnp.float32),
+                            axis=-1).astype(jnp.int32)   # [B, k+1]
+        return chunk, greedy, tuple(c.pools for c in out["cache"])
 
     # -- telemetry ------------------------------------------------------
     def _emit_kv_quant_error(self, ks, vs, length, bucket: int) -> None:
@@ -431,10 +733,13 @@ class ServeEngine:
         reg.gauge("numerics/kv_quant_max_abs_err").set(
             float(mab), step=self._step_count, bucket=bucket)
 
-    def _emit_step_metrics(self, n_active: int, dt_decode: float) -> None:
+    def _emit_step_metrics(self, n_active: int, dt_decode: float,
+                           n_tokens: int) -> None:
         """``dt_decode``: wall seconds of the decode dispatch+fetch only —
         the throughput gauge means DECODE tokens/s, so prefill/admission
-        time on the same step must not dilute it."""
+        time on the same step must not dilute it. ``n_tokens``: tokens
+        appended this step (== active rows, except speculative rounds
+        append up to k+1 per row)."""
         tel = self.telemetry
         if not tel.enabled:
             return
@@ -446,8 +751,8 @@ class ServeEngine:
                                                   step=step)
         reg.gauge("serving/queue_depth").set(self.sched.queue_depth,
                                              step=step)
-        if n_active and dt_decode > 0:
-            self._decode_tokens += n_active
+        if n_tokens and dt_decode > 0:
+            self._decode_tokens += n_tokens
             self._decode_sec += dt_decode
             reg.gauge("serving/tokens_per_sec").set(
                 self._decode_tokens / self._decode_sec, step=step)
@@ -455,6 +760,26 @@ class ServeEngine:
         ctr = reg.counter("serving/preempted_seqs")
         if pre > ctr.total:
             ctr.inc(pre - ctr.total, step=step)
+        # -- fast-path attribution (only when the piece is on: the tag
+        # set a disabled engine emits is byte-identical to PR 8's) ------
+        if self._fast_path and n_active:
+            reg.gauge("serving/decode_attn_kernel").set(
+                1.0 if self._attn_impl == "kernel" else 0.0, step=step)
+        if self.prefix_cache is not None:
+            for tag, total in (
+                    ("serving/prefix_hits", self.prefix_cache.hits),
+                    ("serving/prefix_blocks_reused",
+                     self.prefix_cache.blocks_reused)):
+                ctr = reg.counter(tag)
+                if total > ctr.total:
+                    ctr.inc(total - ctr.total, step=step)
+        if self._spec_k and self.stats["spec_rounds"]:
+            reg.gauge("serving/spec_accept_rate").set(
+                self.stats["spec_accepted"]
+                / max(1, self.stats["spec_proposed"]), step=step)
+            reg.gauge("serving/spec_tokens_per_verify").set(
+                self.stats["spec_new_tokens"] / self.stats["spec_rounds"],
+                step=step)
 
     def close(self) -> None:
         """Flush AND close the telemetry this engine drives (sink file

@@ -28,7 +28,7 @@ Host-side block accounting (:class:`BlockPool`) is plain python — a free
 list is microseconds per step and never touches the device.
 """
 
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,14 @@ class BlockPool:
 
     Block 0 is reserved as the scratch block for inactive batch slots and
     is never handed out; ``capacity`` is therefore ``num_blocks - 1``.
+
+    Blocks are **ref-counted** so the prefix cache can share immutable
+    prompt-head blocks copy-on-write across sequences
+    (``serving/scheduler.py PrefixCache``): ``alloc`` hands out blocks at
+    refcount 1, ``share`` bumps an already-allocated block, and
+    ``release`` decrements — a block returns to the free list only when
+    its last holder lets go. A pool with no sharing behaves exactly like
+    the plain free list it used to be.
     """
 
     SCRATCH = 0
@@ -55,6 +63,7 @@ class BlockPool:
         # sequence must stay microseconds even at multi-thousand-block
         # pools.
         self._free_set = set(self._free)
+        self._refs: Dict[int, int] = {}
 
     @property
     def capacity(self) -> int:
@@ -77,16 +86,38 @@ class BlockPool:
             return None
         taken, self._free = self._free[:n], self._free[n:]
         self._free_set.difference_update(taken)
+        for b in taken:
+            self._refs[b] = 1
         return taken
 
+    def share(self, blocks: List[int]) -> None:
+        """Take one more reference on already-allocated blocks (the COW
+        adoption path — a new sequence, or the prefix cache itself,
+        becomes a co-holder of an immutable prompt-head block)."""
+        for b in blocks:
+            if b == self.SCRATCH:
+                raise ValueError("scratch block cannot be shared")
+            if b not in self._refs:
+                raise ValueError(f"share of unallocated block {b}")
+        for b in blocks:
+            self._refs[b] += 1
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(block, 0)
+
     def release(self, blocks: List[int]) -> None:
+        """Drop one reference per block; a block frees only at zero."""
         for b in blocks:
             if b == self.SCRATCH:
                 raise ValueError("scratch block cannot be released")
-            if b in self._free_set:
+            if b in self._free_set or b not in self._refs:
                 raise ValueError(f"double free of block {b}")
-        self._free.extend(blocks)
-        self._free_set.update(blocks)
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
+                self._free_set.add(b)
 
 
 def init_paged_pools(cfg, num_blocks: int, block_size: int,
@@ -132,7 +163,8 @@ class PagedLayerCache:
     def __init__(self, k: jax.Array, v: jax.Array,
                  k_scale: Optional[jax.Array], v_scale: Optional[jax.Array],
                  block_table: jax.Array, pos: jax.Array,
-                 block_size: int, dtype_name: str = "bfloat16"):
+                 block_size: int, dtype_name: str = "bfloat16",
+                 attn_impl: str = "gather", clamp_writes: bool = False):
         self.k = k
         self.v = v
         self.k_scale = k_scale
@@ -141,16 +173,30 @@ class PagedLayerCache:
         self.pos = pos                      # [B] int32 — next write index
         self.block_size = int(block_size)
         self.dtype_name = dtype_name
+        # Static (aux) knobs of the serving fast path (docs/SERVING.md):
+        # ``attn_impl`` — "gather" (the materializing fallback, and the
+        # bit-identical-to-PR-8 default) or "kernel" (the Pallas paged
+        # decode-attention kernel; the model's paged branch reads it).
+        # ``clamp_writes`` — route out-of-window writes to the scratch
+        # block instead of relying on in-bounds positions; the
+        # speculative-decode verify chunk can legally overshoot a
+        # sequence's allocated blocks (rejected-token lookahead) and its
+        # garbage must land somewhere harmless. Off by default: the plain
+        # decode path never overshoots and must not pay the extra ops.
+        self.attn_impl = str(attn_impl)
+        self.clamp_writes = bool(clamp_writes)
 
     # -- pytree ---------------------------------------------------------
     def tree_flatten(self):
         return ((self.k, self.v, self.k_scale, self.v_scale,
                  self.block_table, self.pos),
-                (self.block_size, self.dtype_name))
+                (self.block_size, self.dtype_name, self.attn_impl,
+                 self.clamp_writes))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, block_size=aux[0], dtype_name=aux[1])
+        return cls(*children, block_size=aux[0], dtype_name=aux[1],
+                   attn_impl=aux[2], clamp_writes=aux[3])
 
     # -- properties -----------------------------------------------------
     @property
@@ -173,7 +219,17 @@ class PagedLayerCache:
         b, s = chunk.shape[:2]
         idx = self.pos[:, None] + jnp.arange(s)[None, :]        # [B, S]
         rows = jnp.arange(b)[:, None]
-        blk = self.block_table[rows, idx // self.block_size]     # [B, S]
+        if self.clamp_writes:
+            # Out-of-window positions (speculative lookahead past a
+            # sequence's last real write) land in the scratch block —
+            # never in a real block another row (or this one) owns.
+            mb = self.block_table.shape[1]
+            blk = self.block_table[rows,
+                                   jnp.minimum(idx // self.block_size,
+                                               mb - 1)]
+            blk = jnp.where(idx < mb * self.block_size, blk, 0)
+        else:
+            blk = self.block_table[rows, idx // self.block_size]  # [B, S]
         off = idx % self.block_size
         if scale is not None:
             q, sc = _quant_tokens(chunk)
@@ -205,13 +261,37 @@ class PagedLayerCache:
         k, ks = self._write(self.k, self.k_scale, k_new)
         v, vs = self._write(self.v, self.v_scale, v_new)
         new = PagedLayerCache(k, v, ks, vs, self.block_table, self.pos,
-                              self.block_size, self.dtype_name)
+                              self.block_size, self.dtype_name,
+                              self.attn_impl, self.clamp_writes)
         kk = new._gather(k, ks)
         vv = new._gather(v, vs)
         qpos = self.pos[:, None] + jnp.arange(s)[None, :]        # [B, S]
         kpos = jnp.arange(self.key_len)
         mask = kpos[None, None, :] <= qpos[:, :, None]           # [B, S, L]
         return new, kk, vv, mask[:, None]                        # [B,1,S,L]
+
+    def update_attend(self, q: jax.Array, k_new: jax.Array,
+                      v_new: jax.Array,
+                      softmax_scale: Optional[float] = None):
+        """Fast-path form of :meth:`update`: write the chunk, then run
+        the Pallas paged decode-attention kernel straight over the pools
+        through the block table — the gathered ``[B, L, H, D]`` K/V copy
+        (and, for int8 pools, its dequantized fp form) is never
+        materialized. Returns ``(new_cache, o [B, S, H, D])``; visibility
+        semantics are identical to the gather path (``kpos <= pos + i``,
+        tier-1 parity-tested in tests/test_serving_fastpath.py)."""
+        from deepspeed_tpu.ops.transformer.paged_attention import \
+            paged_decode_attention
+
+        k, ks = self._write(self.k, self.k_scale, k_new)
+        v, vs = self._write(self.v, self.v_scale, v_new)
+        new = PagedLayerCache(k, v, ks, vs, self.block_table, self.pos,
+                              self.block_size, self.dtype_name,
+                              self.attn_impl, self.clamp_writes)
+        o = paged_decode_attention(q, k, v, ks, vs, self.block_table,
+                                   self.pos, block_size=self.block_size,
+                                   softmax_scale=softmax_scale)
+        return new, o.astype(q.dtype)
 
 
 def pack_prefill(pools: Tuple, blocks: jax.Array,

@@ -339,6 +339,12 @@ class TestDocDrift:
         assert emitted, "the scan must see the serving emissions"
         assert emitted <= SERVING_METRIC_TAGS, (
             emitted - SERVING_METRIC_TAGS)
+        # the decode fast path's per-piece gauges ride this enforcement —
+        # pin them explicitly so a rename can't silently drop a piece's
+        # attribution (docs/SERVING.md "Decode fast path")
+        assert {"serving/decode_attn_kernel", "serving/prefix_hits",
+                "serving/prefix_blocks_reused", "serving/spec_accept_rate",
+                "serving/spec_tokens_per_verify"} <= SERVING_METRIC_TAGS
 
     def test_serving_report_tags_in_sync(self):
         """tools/serving_report.py is stdlib-only by design (no package

@@ -46,10 +46,16 @@ GAUGE_TAGS = (
     "serving/batch_occupancy",
     "serving/kv_blocks_in_use",
     "serving/queue_depth",
+    # decode fast path (docs/SERVING.md "Decode fast path")
+    "serving/decode_attn_kernel",
+    "serving/spec_accept_rate",
+    "serving/spec_tokens_per_verify",
 )
 COUNTER_TAGS = (
     "serving/preempted_seqs",
     "serving/requests_completed",
+    "serving/prefix_hits",
+    "serving/prefix_blocks_reused",
 )
 
 
@@ -147,6 +153,18 @@ def collect(run_dir_or_file: str,
     report["preempted_seqs"] = counters.get("serving/preempted_seqs", 0.0)
     report["requests_completed"] = counters.get(
         "serving/requests_completed", 0.0)
+    # -- decode fast path (rows appear only when the piece emitted) -----
+    kern = series.get("serving/decode_attn_kernel", [])
+    report["decode_attn_kernel_frac"] = (
+        sum(kern) / len(kern)) if kern else None
+    report["prefix_hits"] = counters.get("serving/prefix_hits")
+    report["prefix_blocks_reused"] = counters.get(
+        "serving/prefix_blocks_reused")
+    acc = series.get("serving/spec_accept_rate", [])
+    tpv = series.get("serving/spec_tokens_per_verify", [])
+    # both gauges are cumulative rates: the last value IS the run's
+    report["spec_accept_rate"] = acc[-1] if acc else None
+    report["spec_tokens_per_verify"] = tpv[-1] if tpv else None
     return report
 
 
@@ -174,6 +192,18 @@ def render(report: Dict[str, Any]) -> str:
         q = report["queue_depth"]
         out.append(f"  queue depth     mean {q['mean']:8.2f}   "
                    f"max {q['max']:.0f}")
+    kf = report.get("decode_attn_kernel_frac")
+    if kf is not None:
+        out.append(f"  decode kernel   {kf:8.1%} of decode steps")
+    if report.get("prefix_hits") is not None:
+        reused = report.get("prefix_blocks_reused") or 0
+        out.append(f"  prefix reuse    {report['prefix_hits']:.0f} hits   "
+                   f"{reused:.0f} blocks adopted")
+    acc = report.get("spec_accept_rate")
+    if acc is not None:
+        tpv = report.get("spec_tokens_per_verify") or 0
+        out.append(f"  speculative     accept {acc:8.1%}   "
+                   f"{tpv:.2f} tokens/verify")
     out.append(f"  completed       {report['requests_completed']:.0f} "
                f"requests")
     if not report["n_rows"]:
@@ -212,6 +242,21 @@ def _selftest() -> int:
              "kind": "counter"},
             {"tag": "serving/requests_completed", "value": 5, "step": 2,
              "kind": "counter"},
+            # decode fast path rows
+            {"tag": "serving/decode_attn_kernel", "value": 1.0, "step": 1,
+             "kind": "gauge"},
+            {"tag": "serving/decode_attn_kernel", "value": 0.0, "step": 2,
+             "kind": "gauge"},
+            {"tag": "serving/prefix_hits", "value": 3, "step": 2,
+             "kind": "counter"},
+            {"tag": "serving/prefix_blocks_reused", "value": 12, "step": 2,
+             "kind": "counter"},
+            {"tag": "serving/spec_accept_rate", "value": 0.5, "step": 1,
+             "kind": "gauge"},
+            {"tag": "serving/spec_accept_rate", "value": 0.75, "step": 2,
+             "kind": "gauge"},
+            {"tag": "serving/spec_tokens_per_verify", "value": 2.5,
+             "step": 2, "kind": "gauge"},
             {"tag": "engine/hbm_peak_bytes", "value": 1, "step": 0,
              "kind": "gauge"},                     # non-serving: ignored
         ]
@@ -240,9 +285,19 @@ def _selftest() -> int:
         assert report["preempted_seqs"] == 2
         # running totals: max within a file, summed across host files
         assert report["requests_completed"] == 8
+        # fast-path rows: kernel-step fraction is a mean, prefix counters
+        # sum like the other counters, spec gauges report the LAST
+        # (cumulative) value
+        assert abs(report["decode_attn_kernel_frac"] - 0.5) < 1e-6
+        assert report["prefix_hits"] == 3
+        assert report["prefix_blocks_reused"] == 12
+        assert report["spec_accept_rate"] == 0.75
+        assert report["spec_tokens_per_verify"] == 2.5
         text = render(report)
         assert "TTFT" in text and "occupancy" in text
         assert "completed" in text
+        assert "prefix reuse" in text and "speculative" in text
+        assert "decode kernel" in text
         json.dumps(report)                         # serializable
     print("\nselftest ok")
     return 0
