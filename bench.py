@@ -643,6 +643,12 @@ def main():
         # adopted candidate in its own rows, so a tuned baseline adopted
         # via tools/bench_gate.py --update-baseline stays attributable.
         "autotuning": "off",
+        # MoE (moe/; docs/MOE.md) off on every training section above:
+        # no `moe` config block, so the lowered steps are bit-identical
+        # to the pre-MoE programs (the zero-overhead contract,
+        # tests/test_moe.py). The moe_gpt A/B section below is the only
+        # MoE workload and records its dispatch mode in its own rows.
+        "moe": "off",
         # Serving-section config (docs/SERVING.md): the continuous-
         # batching rows below were measured under exactly this block.
         # Its memory-sink telemetry is scoped to the serving engine and
@@ -972,6 +978,106 @@ def main():
             step_time_tuned_ms=round(times["tuned"] * 1e3, 3),
             autotune_step_speedup=round(speedup, 3))
 
+    def sec_moe_gpt():
+        # MoE GPT dispatch A/B (docs/MOE.md): tiny 4-expert GPT on a
+        # data x expert=2 mesh, the SAME model timed under each dispatch
+        # mode — einsum oracle vs slot-scatter vs explicit all-to-all
+        # (moe/dispatch.py). The modes are numerically parity-tested
+        # (tests/test_moe.py), so the rows are a pure schedule/layout
+        # comparison; on CPU they are schedule-correctness rows. The
+        # timed engines keep telemetry OFF (env block above); the
+        # overflow row comes from one short untimed telemetry-on run,
+        # and the wire row from the static dispatch-bytes model.
+        import deepspeed_tpu
+        from deepspeed_tpu.models import build_specs, make_gpt
+        from deepspeed_tpu.models.gpt import gpt_partition_rules
+        from deepspeed_tpu.parallel.mesh import build_mesh
+        from deepspeed_tpu.telemetry.registry import InMemorySink
+
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        seq = 64 if on_tpu else 32
+        experts = 4
+        model, mcfg = make_gpt(
+            "tiny", dropout_rate=0.0,
+            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+            max_seq_len=max(seq, 128), moe_experts=experts, moe_k=1,
+            moe_layer_freq=2)
+        rng = np.random.default_rng(0)
+        mesh = build_mesh(data=-1, expert=2)
+        dp = n_chips_all // 2
+        # micro 2/chip: tokens (2*dp*seq) divide the dispatch grid
+        # (data-like x expert = n_chips) for the all-to-all manual region
+        ids = rng.integers(0, mcfg.vocab_size, (1, 2 * dp, seq),
+                           dtype=np.int32)
+        params = model.init({"params": jax.random.PRNGKey(0),
+                             "dropout": jax.random.PRNGKey(1)},
+                            {"input_ids": ids[0]})["params"]
+        specs = build_specs(params, gpt_partition_rules(),
+                            mesh_axes=dict(mesh.shape))
+
+        def moe_engine(dispatch, telemetry=None):
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=model, params=params, mesh=mesh,
+                param_partition_specs=specs,
+                config={
+                    "train_micro_batch_size_per_gpu": 2,
+                    "gradient_accumulation_steps": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                    "zero_optimization": {"stage": 1},
+                    "moe": {"enabled": True, "num_experts": experts,
+                            "k": 1, "dispatch": dispatch},
+                    **(telemetry or {}),
+                })
+            return engine
+
+        times = {}
+        for mode in ("einsum", "scatter", "alltoall"):
+            engine = moe_engine(mode)
+            dt, _ = time_train_batches(engine, {"input_ids": ids},
+                                       max(steps, 2), warmup, windows=2)
+            times[mode] = dt / max(steps, 2)
+            del engine
+        # Untimed stats run (scatter — the mode is irrelevant for the
+        # routing stats): real overflow fraction off the moe/* gauges.
+        import tempfile as _tempfile
+        with _tempfile.TemporaryDirectory() as tdir:
+            engine = moe_engine("scatter", telemetry={
+                "telemetry": {"enabled": True, "dir": tdir},
+                "steps_per_print": 1})
+            sink = engine.telemetry.registry.add_sink(InMemorySink())
+            for _ in range(2):
+                engine.train_batch({"input_ids": ids})
+            overflow = [r["value"] for r in sink.rows
+                        if r["tag"] == "moe/capacity_overflow_frac"]
+            del engine
+        from deepspeed_tpu.moe.dispatch import modeled_dispatch_bytes_ici
+        tokens = 2 * dp * seq
+        capacity = max(4, int(np.ceil(tokens / experts * 1.25)))
+        wire = modeled_dispatch_bytes_ici(
+            num_experts=experts, capacity=capacity, hidden=mcfg.hidden_size,
+            dtype=jnp.bfloat16 if on_tpu else jnp.float32, mesh=mesh)
+        a2a_vs_scatter = (times["scatter"] / times["alltoall"]
+                          if times["alltoall"] else 0.0)
+        log(f"[bench] moe_gpt dispatch A/B (tiny {experts}-expert GPT, "
+            f"expert=2): einsum {times['einsum'] * 1e3:.1f} ms/step, "
+            f"scatter {times['scatter'] * 1e3:.1f} ms/step, alltoall "
+            f"{times['alltoall'] * 1e3:.1f} ms/step "
+            f"({a2a_vs_scatter:.2f}x vs scatter), overflow "
+            f"{(overflow[-1] if overflow else 0):.3f}, modeled wire "
+            f"{wire} B/layer ({time.time() - t0:.0f}s)")
+        result["moe_gpt_alltoall_vs_scatter"] = round(a2a_vs_scatter, 3)
+        _section_rows(
+            result, "moe_gpt",
+            step_time_einsum_ms=round(times["einsum"] * 1e3, 3),
+            step_time_scatter_ms=round(times["scatter"] * 1e3, 3),
+            step_time_alltoall_ms=round(times["alltoall"] * 1e3, 3),
+            alltoall_vs_scatter_speedup=round(a2a_vs_scatter, 3),
+            dispatch_bytes_ici_per_layer=int(wire),
+            capacity_overflow_frac=round(
+                overflow[-1] if overflow else 0.0, 4))
+
     sections = [("bert128", sec_bert128)]
     if on_tpu:
         sections += [("bert512", sec_bert512), ("gpt2", sec_gpt2),
@@ -992,6 +1098,12 @@ def main():
     # measurement.
     if n_chips_all >= 4 and (n_chips_all & (n_chips_all - 1)) == 0:
         sections += [("zeropp", sec_zeropp)]
+    # The MoE dispatch A/B needs an expert axis of 2 with a data axis
+    # left over (>= 4 even chips); the all-to-all manual region also
+    # wants the token count divisible by the full dispatch grid, which
+    # the micro-batch choice above guarantees for even chip counts.
+    if n_chips_all >= 4 and n_chips_all % 2 == 0:
+        sections += [("moe_gpt", sec_moe_gpt)]
     n_ok = 0
     for name, fn in sections:
         n_ok += bool(run_section(name, fn, result))

@@ -130,6 +130,57 @@ def initialize(args=None,
                  "over the data axes (ops/embedding.py row-sparse VJP)",
                  ranks=[0])
 
+    if cfg.moe.enabled and model is not None and loss_fn is None \
+            and hasattr(model, "cfg") \
+            and hasattr(model.cfg, "moe_experts"):
+        # Config-driven MoE surgery (the `moe` block; docs/MOE.md): route
+        # every moe.layer_freq-th block's FFN through the GShard MoE
+        # layer with the block's knobs — frozen-dataclass replace, like
+        # the sparse_attention/sparse_gradients surgeries above. The
+        # ENGINE's mesh is resolved here and pinned into cfg.moe_mesh so
+        # the all-to-all dispatch region never binds to whatever ambient
+        # mesh an unrelated engine registered (the multi-engine footgun
+        # the sparse_gradients pinning exists for). moe_stats follows
+        # telemetry.enabled: the stat scalars only ride the step when an
+        # engine-side flush point (telemetry/moe.py) will consume them.
+        from dataclasses import replace as _dc_replace
+
+        from deepspeed_tpu.parallel.mesh import build_mesh as _build_mesh
+        from deepspeed_tpu.utils.logging import log_dist
+
+        if mesh is None:
+            mesh = _build_mesh(data=-1, model=cfg.mesh.model,
+                               pipe=cfg.mesh.pipe,
+                               sequence=cfg.mesh.sequence,
+                               expert=cfg.mesh.expert,
+                               slices=cfg.mesh.slices)
+        # The config-parse wall only sees a `mesh` config block; a mesh
+        # OBJECT handed to initialize() resolves its expert axis here.
+        _e_axis = mesh.shape.get("expert", 1)
+        if _e_axis > 1 and cfg.moe.num_experts % _e_axis != 0:
+            from deepspeed_tpu.config.config import ConfigError
+            raise ConfigError(
+                f"moe.num_experts ({cfg.moe.num_experts}) must divide "
+                f"evenly over the mesh expert axis ({_e_axis})")
+        model = type(model)(cfg=_dc_replace(
+            model.cfg,
+            moe_experts=cfg.moe.num_experts,
+            moe_k=cfg.moe.k,
+            moe_layer_freq=cfg.moe.layer_freq,
+            moe_capacity_factor=cfg.moe.capacity_factor,
+            moe_eval_capacity_factor=cfg.moe.eval_capacity_factor,
+            moe_min_capacity=cfg.moe.min_capacity,
+            moe_router_jitter=cfg.moe.router_jitter,
+            moe_dispatch=cfg.moe.dispatch,
+            moe_mesh=mesh,
+            moe_stats=cfg.telemetry.enabled))
+        log_dist(
+            f"moe: {cfg.moe.num_experts} experts (k={cfg.moe.k}, "
+            f"dispatch={cfg.moe.dispatch}, capacity_factor="
+            f"{cfg.moe.capacity_factor}) every {cfg.moe.layer_freq} "
+            f"blocks; expert axis size {mesh.shape.get('expert', 1)}",
+            ranks=[0])
+
     if cfg.zero_config.offload_param.enabled and loss_fn is not None:
         raise ValueError(
             "offload_param cannot stream an opaque loss_fn (no per-block "

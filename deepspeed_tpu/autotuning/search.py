@@ -146,10 +146,41 @@ def _apply_candidate(engine, parsed_cfg, cand: Candidate, snapshot,
     world-change path (same devices, same world), and reinstall the
     pre-search snapshot so every trial starts from identical state."""
     engine.config = parsed_cfg
+    _apply_moe_knobs(engine, parsed_cfg)
     engine._elastic_rebuild(
         devices=devices, slices=engine.dcn_size,
         micro_batch=cand.micro, gas=cand.gas,
         arrays=dict(snapshot.arrays), meta=snapshot.meta)
+
+
+def _apply_moe_knobs(engine, parsed_cfg) -> None:
+    """moe capacity-factor/dispatch trials change the LOWERED step, not
+    the param shapes — re-derive the module-backed loss_fn with the
+    candidate's knobs so the rebuild below traces them (the adapter
+    publishes ``loss_fn.module`` for exactly this). No-op for bare
+    loss_fn entries (nothing to re-derive) and when the knobs already
+    match. moe_experts never reaches a trial (prune-only axis): a
+    different expert count changes the param tree the snapshot reinstall
+    assumes."""
+    moe = getattr(parsed_cfg, "moe", None)
+    if moe is None or not moe.enabled:
+        return
+    module = getattr(engine.loss_fn, "module", None)
+    mcfg = getattr(module, "cfg", None)
+    if mcfg is None or not hasattr(mcfg, "moe_dispatch"):
+        return
+    if (mcfg.moe_capacity_factor == moe.capacity_factor
+            and mcfg.moe_dispatch == moe.dispatch):
+        return
+    from dataclasses import replace as _dc_replace
+
+    from deepspeed_tpu.models.adapter import flax_module_loss_fn
+
+    new_module = type(module)(cfg=_dc_replace(
+        mcfg, moe_capacity_factor=moe.capacity_factor,
+        moe_dispatch=moe.dispatch))
+    engine.loss_fn, _ = flax_module_loss_fn(new_module,
+                                            params=engine.state.params)
 
 
 def _run_trial(engine, cand: Candidate, make_batches: Callable,
@@ -305,7 +336,43 @@ def _autotune_inner(engine, make_batches: Callable[[int, int], Any], *,
                         key=lambda c: _rec(records, c.name)["modeled_sec"])
         for i, cand in enumerate(ranked):
             _rec(records, cand.name)["rank"] = i + 1
-        trial_list = ranked[:acfg.top_k]
+        # MoE trialability: a different expert count changes the param
+        # tree shapes, and every trial reinstalls the pre-search snapshot
+        # arrays — moe_experts is prune-only (enumerated, config-parse
+        # pruned, capacity-projected, never measured). Capacity-factor/
+        # dispatch trials additionally need the module handle the adapter
+        # publishes to re-derive the loss — bare loss_fn entries cannot
+        # retrace the knobs, so those candidates are not trialed either
+        # (measuring an unchanged program would be a lie).
+        base_moe = getattr(base_cfg, "moe", None)
+        untrialable = []
+        if base_moe is not None and base_moe.enabled:
+            has_module = getattr(engine.loss_fn, "module", None) is not None
+            for cand in ranked:
+                if cand.moe_experts not in (None, base_moe.num_experts):
+                    untrialable.append(
+                        (cand, "moe_experts is a prune-only axis: a "
+                         "different expert count changes the param tree "
+                         "shapes the in-process trial's snapshot "
+                         "reinstall assumes (modeled + capacity ranking "
+                         "only)"))
+                elif (not has_module
+                      and (cand.moe_capacity_factor
+                           not in (None, base_moe.capacity_factor)
+                           or cand.moe_dispatch
+                           not in (None, base_moe.dispatch))):
+                    untrialable.append(
+                        (cand, "moe capacity/dispatch knobs need a "
+                         "module-backed loss_fn to retrace — this engine "
+                         "was built from a bare loss_fn"))
+        for cand, reason in untrialable:
+            rec = _rec(records, cand.name)
+            if rec["status"] == "enumerated":
+                rec["status"] = "not_trialed"
+                rec["reason"] = reason
+        skip = {id(c) for c, _ in untrialable}
+        trialable = [c for c in ranked if id(c) not in skip]
+        trial_list = trialable[:acfg.top_k]
         if not any(c.name == "default" for c in trial_list):
             # The incumbent is ALWAYS measured: "the winner beat the
             # default" must be a measured statement, never a modeled one.
@@ -317,7 +384,7 @@ def _autotune_inner(engine, make_batches: Callable[[int, int], Any], *,
                              None)
             if incumbent is not None:
                 trial_list.append(incumbent)
-        for cand in ranked[acfg.top_k:]:
+        for cand in trialable[acfg.top_k:]:
             rec = _rec(records, cand.name)
             if rec["status"] == "enumerated" and cand not in trial_list:
                 rec["status"] = "not_trialed"

@@ -49,6 +49,15 @@ class Candidate:
     dcn_quant_bits: Optional[int] = None
     overlap: Optional[str] = None
     zeropp: Optional[str] = None         # off | bf16 | int8
+    # MoE axes (None => base value / moe disabled). moe_experts is a
+    # PRUNE-ONLY axis: a different expert count changes the param tree
+    # shapes, and a measured trial reinstalls the pre-search snapshot
+    # arrays (search.py _apply_candidate) — so non-base expert counts
+    # ride enumeration + config-parse pruning + the capacity projection
+    # but are never trialed in-process (search.py records not_trialed).
+    moe_experts: Optional[int] = None
+    moe_capacity_factor: Optional[float] = None
+    moe_dispatch: Optional[str] = None
     overrides: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -148,13 +157,36 @@ def enumerate_candidates(config, mesh_shape: Dict[str, int],
     base_zpp_tier = (base_zpp.quantized_weights
                      if getattr(base_zpp, "active", False) else "off")
 
+    # MoE axes exist only when the workload IS MoE (the moe block on);
+    # moe_experts is prune-only — see the Candidate field comment.
+    base_moe = getattr(config, "moe", None)
+    moe_on = base_moe is not None and base_moe.enabled
+    if moe_on:
+        experts_axis = tuple(acfg.moe_experts) or (base_moe.num_experts,)
+        cf_axis = (tuple(acfg.moe_capacity_factors)
+                   or (base_moe.capacity_factor,))
+        disp_axis = tuple(acfg.moe_dispatch) or (base_moe.dispatch,)
+    else:
+        experts_axis = (None,)
+        cf_axis = (None,)
+        disp_axis = (None,)
+        if acfg.moe_experts or acfg.moe_capacity_factors or acfg.moe_dispatch:
+            notes.append("moe axes collapsed: the moe block is disabled — "
+                         "no expert layer to tune")
+
     def base_knobs(stage: int, micro: int, gas: int) -> Candidate:
         return Candidate(name="", zero_stage=stage, micro=micro, gas=gas,
                          hierarchical=base_comm.hierarchical,
                          bucket_mb=base_comm.bucket_mb,
                          dcn_quant_bits=base_comm.dcn_quant_bits,
                          overlap=base_comm.overlap_grad_sync,
-                         zeropp=base_zpp_tier)
+                         zeropp=base_zpp_tier,
+                         moe_experts=(base_moe.num_experts
+                                      if moe_on else None),
+                         moe_capacity_factor=(base_moe.capacity_factor
+                                              if moe_on else None),
+                         moe_dispatch=(base_moe.dispatch
+                                       if moe_on else None))
 
     out: List[Candidate] = []
     seen = set()
@@ -167,7 +199,8 @@ def enumerate_candidates(config, mesh_shape: Dict[str, int],
         ov = "off" if c.overlap == "off" else "on"
         hi = ("off" if c.hierarchical == "off" else "on")
         key = (c.zero_stage, c.micro, c.gas, hi, c.bucket_mb,
-               c.dcn_quant_bits, ov, c.zeropp)
+               c.dcn_quant_bits, ov, c.zeropp,
+               c.moe_experts, c.moe_capacity_factor, c.moe_dispatch)
         if key in seen:
             return
         seen.add(key)
@@ -202,15 +235,28 @@ def enumerate_candidates(config, mesh_shape: Dict[str, int],
                                    else (base_comm.overlap_grad_sync,)):
                             for zpp in (zpp_axis if stage >= 2
                                         else ("off",)):
-                                c = Candidate(
-                                    name="", zero_stage=int(stage),
-                                    micro=int(micro), gas=int(gas),
-                                    hierarchical=hier,
-                                    bucket_mb=float(bucket),
-                                    dcn_quant_bits=int(bits),
-                                    overlap=ov, zeropp=zpp)
-                                c.name = _candidate_name(c, comm_active)
-                                add(c)
+                                for ne in experts_axis:
+                                    for cf in cf_axis:
+                                        for disp in disp_axis:
+                                            c = Candidate(
+                                                name="",
+                                                zero_stage=int(stage),
+                                                micro=int(micro),
+                                                gas=int(gas),
+                                                hierarchical=hier,
+                                                bucket_mb=float(bucket),
+                                                dcn_quant_bits=int(bits),
+                                                overlap=ov, zeropp=zpp,
+                                                moe_experts=(
+                                                    int(ne) if ne
+                                                    is not None else None),
+                                                moe_capacity_factor=(
+                                                    float(cf) if cf
+                                                    is not None else None),
+                                                moe_dispatch=disp)
+                                            c.name = _candidate_name(
+                                                c, comm_active)
+                                            add(c)
 
     if len(out) > acfg.max_candidates:
         notes.append(
@@ -232,6 +278,10 @@ def _candidate_name(c: Candidate, comm_active: bool) -> str:
                 parts.append("noovl")
     if c.zeropp and c.zeropp != "off":
         parts.append(f"zpp-{c.zeropp}")
+    if c.moe_experts is not None:
+        parts.append(f"e{c.moe_experts}")
+        parts.append(f"cf{c.moe_capacity_factor:g}")
+        parts.append(str(c.moe_dispatch))
     return "-".join(parts)
 
 
@@ -279,6 +329,14 @@ def materialize(base_param_dict: Dict[str, Any], cand: Candidate,
         comm[C.COMM_OVERLAP_GRAD_SYNC] = cand.overlap
     d[C.COMM] = comm
 
+    if cand.moe_experts is not None:
+        moe = dict(d.get(C.MOE) or {})
+        moe[C.MOE_ENABLED] = True
+        moe[C.MOE_NUM_EXPERTS] = int(cand.moe_experts)
+        moe[C.MOE_CAPACITY_FACTOR] = float(cand.moe_capacity_factor)
+        moe[C.MOE_DISPATCH] = cand.moe_dispatch
+        d[C.MOE] = moe
+
     if not config.elasticity_enabled:
         dp = config.data_parallel_size
         d[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = int(cand.micro)
@@ -312,4 +370,12 @@ def _diff_overrides(cand: Candidate, config) -> Dict[str, Any]:
         out["overlap_grad_sync"] = cand.overlap
     if cand.zeropp not in (None, base_tier):
         out["zeropp"] = cand.zeropp
+    base_moe = getattr(config, "moe", None)
+    if base_moe is not None and base_moe.enabled:
+        if cand.moe_experts not in (None, base_moe.num_experts):
+            out["moe_experts"] = cand.moe_experts
+        if cand.moe_capacity_factor not in (None, base_moe.capacity_factor):
+            out["moe_capacity_factor"] = cand.moe_capacity_factor
+        if cand.moe_dispatch not in (None, base_moe.dispatch):
+            out["moe_dispatch"] = cand.moe_dispatch
     return out

@@ -1074,6 +1074,89 @@ def _str_tuple(v, name: str) -> tuple:
 
 
 @dataclass
+class MoeConfig:
+    """``moe`` block — expert-parallel MoE training (moe/; docs/MOE.md).
+
+    When enabled, ``deepspeed_tpu.initialize(model=...)`` swaps the
+    in-tree GPT family's FFN blocks for MoE layers (every
+    ``layer_freq``-th block), pins the engine mesh into the layer so the
+    ``alltoall`` dispatch path has its expert axis, and — with telemetry
+    on — turns on the moe/* gauges and per-expert numerics groups. A
+    present block defaults to enabled (set ``enabled: false`` to keep a
+    block around inert). Absent/off is provably free: no surgery, no
+    extra step outputs, bit-identical lowered train step
+    (tests/test_moe.py pins it)."""
+
+    enabled: bool = C.MOE_ENABLED_DEFAULT
+    num_experts: int = C.MOE_NUM_EXPERTS_DEFAULT
+    k: int = C.MOE_TOP_K_DEFAULT
+    layer_freq: int = C.MOE_LAYER_FREQ_DEFAULT
+    capacity_factor: float = C.MOE_CAPACITY_FACTOR_DEFAULT
+    eval_capacity_factor: float = C.MOE_EVAL_CAPACITY_FACTOR_DEFAULT
+    min_capacity: int = C.MOE_MIN_CAPACITY_DEFAULT
+    aux_alpha: float = C.MOE_AUX_ALPHA_DEFAULT
+    router_jitter: float = C.MOE_ROUTER_JITTER_DEFAULT
+    dispatch: str = C.MOE_DISPATCH_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "MoeConfig":
+        # an empty `moe: {}` block is still an opt-in (all defaults)
+        present = d is not None
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.MOE_ENABLED, present)),
+            num_experts=int(_get(d, C.MOE_NUM_EXPERTS,
+                                 C.MOE_NUM_EXPERTS_DEFAULT)),
+            k=int(_get(d, C.MOE_TOP_K, C.MOE_TOP_K_DEFAULT)),
+            layer_freq=int(_get(d, C.MOE_LAYER_FREQ,
+                                C.MOE_LAYER_FREQ_DEFAULT)),
+            capacity_factor=float(_get(d, C.MOE_CAPACITY_FACTOR,
+                                       C.MOE_CAPACITY_FACTOR_DEFAULT)),
+            eval_capacity_factor=float(_get(
+                d, C.MOE_EVAL_CAPACITY_FACTOR,
+                C.MOE_EVAL_CAPACITY_FACTOR_DEFAULT)),
+            min_capacity=int(_get(d, C.MOE_MIN_CAPACITY,
+                                  C.MOE_MIN_CAPACITY_DEFAULT)),
+            aux_alpha=float(_get(d, C.MOE_AUX_ALPHA,
+                                 C.MOE_AUX_ALPHA_DEFAULT)),
+            router_jitter=float(_get(d, C.MOE_ROUTER_JITTER,
+                                     C.MOE_ROUTER_JITTER_DEFAULT)),
+            dispatch=str(_get(d, C.MOE_DISPATCH,
+                              C.MOE_DISPATCH_DEFAULT)).lower(),
+        )
+        if not cfg.enabled:
+            return cfg
+        if cfg.num_experts < 2:
+            raise ConfigError(
+                f"moe.num_experts must be >= 2, got {cfg.num_experts}")
+        if cfg.k not in (1, 2):
+            raise ConfigError(f"moe.k must be 1 or 2, got {cfg.k}")
+        if cfg.layer_freq < 1:
+            raise ConfigError(
+                f"moe.layer_freq must be >= 1, got {cfg.layer_freq}")
+        if cfg.capacity_factor <= 0 or cfg.eval_capacity_factor <= 0:
+            raise ConfigError(
+                f"moe capacity factors must be positive, got "
+                f"{cfg.capacity_factor}/{cfg.eval_capacity_factor}")
+        if cfg.min_capacity < 1:
+            raise ConfigError(
+                f"moe.min_capacity must be >= 1, got {cfg.min_capacity}")
+        if cfg.aux_alpha < 0:
+            raise ConfigError(
+                f"moe.aux_alpha must be >= 0, got {cfg.aux_alpha}")
+        if not (0.0 <= cfg.router_jitter < 1.0):
+            raise ConfigError(
+                f"moe.router_jitter must be in [0, 1), got "
+                f"{cfg.router_jitter}")
+        if cfg.dispatch not in C.MOE_DISPATCH_CHOICES:
+            raise ConfigError(
+                f"moe.dispatch must be drawn from "
+                f"{'/'.join(C.MOE_DISPATCH_CHOICES)}, got "
+                f"'{cfg.dispatch}'")
+        return cfg
+
+
+@dataclass
 class AutotuningConfig:
     """``autotuning`` block — the startup config search
     (autotuning/; docs/PERFORMANCE.md "Autotuning").
@@ -1099,6 +1182,9 @@ class AutotuningConfig:
     dcn_quant_bits: tuple = ()
     overlap: tuple = ()              # overlap_grad_sync values
     zeropp: tuple = ()               # quantized_weights tiers
+    moe_experts: tuple = ()          # expert counts (prune-only axis)
+    moe_capacity_factors: tuple = ()
+    moe_dispatch: tuple = ()         # einsum | scatter | alltoall
     top_k: int = C.AUTOTUNING_TOP_K_DEFAULT
     trial_steps: int = C.AUTOTUNING_TRIAL_STEPS_DEFAULT
     trial_warmup: int = C.AUTOTUNING_TRIAL_WARMUP_DEFAULT
@@ -1147,6 +1233,13 @@ class AutotuningConfig:
                                "autotuning.overlap"),
             zeropp=_str_tuple(d.get(C.AUTOTUNING_ZEROPP),
                               "autotuning.zeropp"),
+            moe_experts=_int_tuple(d.get(C.AUTOTUNING_MOE_EXPERTS),
+                                   "autotuning.moe_experts"),
+            moe_capacity_factors=_float_tuple(
+                d.get(C.AUTOTUNING_MOE_CAPACITY_FACTORS),
+                "autotuning.moe_capacity_factors"),
+            moe_dispatch=_str_tuple(d.get(C.AUTOTUNING_MOE_DISPATCH),
+                                    "autotuning.moe_dispatch"),
             top_k=int(_get(d, C.AUTOTUNING_TOP_K,
                            C.AUTOTUNING_TOP_K_DEFAULT)),
             trial_steps=int(_get(d, C.AUTOTUNING_TRIAL_STEPS,
@@ -1214,6 +1307,21 @@ class AutotuningConfig:
             raise ConfigError(
                 f"autotuning.zeropp must be drawn from off/bf16/int8, "
                 f"got {bad}")
+        bad = [e for e in cfg.moe_experts if e < 2]
+        if bad:
+            raise ConfigError(
+                f"autotuning.moe_experts must be >= 2, got {bad}")
+        bad = [f for f in cfg.moe_capacity_factors if f <= 0]
+        if bad:
+            raise ConfigError(
+                f"autotuning.moe_capacity_factors must be positive, "
+                f"got {bad}")
+        bad = [m for m in cfg.moe_dispatch
+               if m not in C.MOE_DISPATCH_CHOICES]
+        if bad:
+            raise ConfigError(
+                f"autotuning.moe_dispatch must be drawn from "
+                f"{'/'.join(C.MOE_DISPATCH_CHOICES)}, got {bad}")
         if any(m < 1 or g < 1 for m, g in cfg.micro_gas):
             raise ConfigError(
                 f"autotuning.micro_gas pairs must be positive, got "
@@ -1388,6 +1496,7 @@ class DeepSpeedTPUConfig:
         self.guardrails = GuardrailsConfig.from_dict(d.get(C.GUARDRAILS))
         self.serving = ServingConfig.from_dict(d.get(C.SERVING))
         self.autotuning = AutotuningConfig.from_dict(d.get(C.AUTOTUNING))
+        self.moe = MoeConfig.from_dict(d.get(C.MOE))
         self.sparse_attention = d.get(C.SPARSE_ATTENTION)
         self.pipeline = dict(d.get(C.PIPELINE, {}))
         self.eigenvalue = dict(d.get(C.EIGENVALUE, {}))
@@ -1575,6 +1684,39 @@ class DeepSpeedTPUConfig:
                     "autotuning cannot compose with 1-bit optimizers: the "
                     "error-compensated compressed-momentum buffers are "
                     "rank-local and do not survive a trial rebuild")
+        if self.moe.enabled:
+            # Expert-parallel composition walls (docs/MOE.md): the tiers
+            # below own a state layout or program the expert-axis-sharded
+            # stacked params cannot ride — fail at parse with the real
+            # cause. These walls are also what makes the moe autotuner
+            # axes prune invalid combos for free.
+            if self.moe.num_experts % max(self.mesh.expert, 1) != 0:
+                raise ConfigError(
+                    f"moe.num_experts ({self.moe.num_experts}) must "
+                    f"divide by the mesh expert axis "
+                    f"({self.mesh.expert}): experts are one stacked "
+                    f"leaf sharded over that axis")
+            if (self.mesh.pipe > 1
+                    or int(self.pipeline.get("stages", 1)) > 1):
+                raise ConfigError(
+                    "moe cannot compose with pipeline parallelism: the "
+                    "pipe engine stacks its blocks into one scanned "
+                    "program — a per-layer FFN/MoE swap breaks the "
+                    "homogeneous stack; use the fused data-parallel "
+                    "engine")
+            if (self.zero_config.offload_param.enabled
+                    or self.zero_config.offload_optimizer.enabled):
+                raise ConfigError(
+                    "moe cannot compose with the offload tiers: the "
+                    "host-resident master partition is laid out over "
+                    "(data,) flat shards and the expert-axis-sharded "
+                    "stacked params do not fit it")
+            if str(self.optimizer_name or "").startswith("onebit"):
+                raise ConfigError(
+                    "moe cannot compose with 1-bit optimizers: the "
+                    "error-feedback buffers assume the (data,)-only "
+                    "grad bucket layout, which expert-axis-sharded "
+                    "grads break")
         if (self.telemetry.memory.enabled and self.guardrails.watchdog.enabled
                 and self.telemetry.memory.oom_exit_code
                 == self.guardrails.watchdog.exit_code):

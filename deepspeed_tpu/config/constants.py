@@ -538,3 +538,44 @@ AUTOTUNING_MAX_CANDIDATES = "max_candidates"
 AUTOTUNING_MAX_CANDIDATES_DEFAULT = 64
 AUTOTUNING_RESULT_FILE = "result_file"
 AUTOTUNING_RESULT_FILE_DEFAULT = "autotune_result.json"
+# MoE axes (active only when the moe block is enabled; collapsed with a
+# note otherwise). capacity_factor and dispatch are trial-safe —
+# lowering-only changes. num_experts re-shapes the expert params, so
+# candidates that change it are enumerated (the config-parse walls prune
+# invalid counts for free) but never measured in-process: the trial
+# rebuild reinstalls the pre-search parameter snapshot, which an
+# expert-count change cannot fit.
+AUTOTUNING_MOE_EXPERTS = "moe_experts"
+AUTOTUNING_MOE_CAPACITY_FACTORS = "moe_capacity_factors"
+AUTOTUNING_MOE_DISPATCH = "moe_dispatch"
+
+#############################################
+# MoE / expert parallelism (moe/; docs/MOE.md): the GShard-style MoE FFN
+# swap for the in-tree GPT family plus the explicit all-to-all dispatch
+# path. Default ABSENT: no moe block => initialize() performs no model
+# surgery and the lowered train step is bit-identical (tests/test_moe.py
+# pins it). The moe/* gauge names are declared in telemetry/moe.py
+# MOE_METRIC_TAGS, doc-lint-pinned like numerics/goodput.
+#############################################
+MOE = "moe"
+MOE_ENABLED = "enabled"
+MOE_ENABLED_DEFAULT = False
+MOE_NUM_EXPERTS = "num_experts"
+MOE_NUM_EXPERTS_DEFAULT = 8
+MOE_TOP_K = "k"                                # top-k routing (1 or 2)
+MOE_TOP_K_DEFAULT = 1
+MOE_LAYER_FREQ = "layer_freq"                  # every Nth block is MoE
+MOE_LAYER_FREQ_DEFAULT = 2
+MOE_CAPACITY_FACTOR = "capacity_factor"
+MOE_CAPACITY_FACTOR_DEFAULT = 1.25
+MOE_EVAL_CAPACITY_FACTOR = "eval_capacity_factor"
+MOE_EVAL_CAPACITY_FACTOR_DEFAULT = 2.0
+MOE_MIN_CAPACITY = "min_capacity"
+MOE_MIN_CAPACITY_DEFAULT = 4
+MOE_AUX_ALPHA = "aux_alpha"                    # load-balance loss scale
+MOE_AUX_ALPHA_DEFAULT = 0.01
+MOE_ROUTER_JITTER = "router_jitter"            # train-only input jitter
+MOE_ROUTER_JITTER_DEFAULT = 0.0
+MOE_DISPATCH = "dispatch"
+MOE_DISPATCH_DEFAULT = "scatter"
+MOE_DISPATCH_CHOICES = ("einsum", "scatter", "alltoall")

@@ -41,6 +41,11 @@ def flax_module_loss_fn(module, params: Any = None,
             return loss, aux
         return out
 
+    # Published so config-driven re-derivations (the autotuner's moe
+    # capacity/dispatch trials, autotuning/search.py) can rebuild the
+    # loss with a replaced module cfg — the engine itself never holds
+    # the module.
+    loss_fn.module = module
     return loss_fn, params
 
 

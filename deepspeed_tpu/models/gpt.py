@@ -98,6 +98,20 @@ class GPTConfig:
     moe_layer_freq: int = 2            # every Nth block is MoE
     moe_capacity_factor: float = 1.25
     moe_aux_alpha: float = 0.01
+    moe_eval_capacity_factor: float = 2.0
+    moe_min_capacity: int = 4
+    moe_router_jitter: float = 0.0     # train-only router input jitter
+    # Dispatch mode: "scatter" | "einsum" | "alltoall" (moe/dispatch.py —
+    # the explicit expert-axis exchange; needs moe_mesh or the ambient
+    # default mesh). deepspeed_tpu.initialize() injects these from the
+    # engine's `moe` config block, pinning the ENGINE's mesh like
+    # sparse_embedding_grad.
+    moe_dispatch: str = "scatter"
+    moe_mesh: Any = None
+    # When True the model output dict grows moe_* stat scalars (mean over
+    # the MoE layers; dispatch bytes summed) for the engine's moe/*
+    # gauges (telemetry/moe.py).
+    moe_stats: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -283,11 +297,23 @@ class GPTBlock(nn.Module):
             if self.moe:
                 from deepspeed_tpu.moe import MoE, MoEConfig
 
-                h, aux = MoE(MoEConfig(
+                moe_out = MoE(MoEConfig(
                     hidden_size=d, num_experts=cfg.moe_experts, k=cfg.moe_k,
                     capacity_factor=cfg.moe_capacity_factor,
+                    eval_capacity_factor=cfg.moe_eval_capacity_factor,
+                    min_capacity=cfg.moe_min_capacity,
+                    router_jitter=cfg.moe_router_jitter,
+                    dispatch=cfg.moe_dispatch, mesh=cfg.moe_mesh,
+                    stats=cfg.moe_stats,
                     expert_intermediate=cfg.mlp_ratio * d, dtype=dt),
                     name="moe")(h, deterministic=deterministic)
+                if cfg.moe_stats:
+                    # Bundle (aux, stats) so the block's return arity
+                    # stays fixed; GPT unpacks the pair.
+                    h, aux_loss, moe_stats = moe_out
+                    aux = (aux_loss, moe_stats)
+                else:
+                    h, aux = moe_out
             else:
                 h = nn.Dense(cfg.mlp_ratio * d, dtype=dt, name="c_fc")(h)
                 h = nn.gelu(h, approximate=True)
@@ -397,6 +423,7 @@ class GPT(nn.Module):
         pld_theta = batch.get("pld_theta") if isinstance(batch, dict) else None
         new_cache = []
         aux_total = jnp.float32(0.0)
+        moe_layer_stats = []
 
         def is_moe(i):
             return (cfg.moe_experts > 0
@@ -412,6 +439,9 @@ class GPT(nn.Module):
                 aux_i = None
                 if is_moe(i):
                     y, aux_i = y
+                    if cfg.moe_stats:
+                        aux_i, stats_i = aux_i
+                        moe_layer_stats.append(stats_i)
                 if pld_theta is not None and not deterministic:
                     from deepspeed_tpu.runtime.progressive_layer_drop import \
                         pld_keep_gate
@@ -461,7 +491,17 @@ class GPT(nn.Module):
             loss = cross_entropy_with_ignore(logits, labels)
         if cfg.moe_experts > 0:
             loss = loss + cfg.moe_aux_alpha * aux_total
-        return {"loss": loss, "logits": logits}
+        out = {"loss": loss, "logits": logits}
+        if moe_layer_stats:
+            # moe_* stat scalars for the engine's moe/* gauges
+            # (telemetry/moe.py MOE_AUX_KEYS): mean over the MoE layers,
+            # except the modeled wire bytes, which sum.
+            n = float(len(moe_layer_stats))
+            for key in moe_layer_stats[0]:
+                total = sum(s[key] for s in moe_layer_stats)
+                out["moe_" + key] = (
+                    total if key == "dispatch_bytes_ici" else total / n)
+        return out
 
 
 def init_kv_cache(cfg: GPTConfig, batch_size: int, max_len: int,

@@ -48,8 +48,19 @@ class MoEConfig:
     # O(T·E ints + E·C·D) — linear in tokens. "einsum": the classic GShard
     # [T,E,C] one-hot einsums — O(T²·factor/E) floats, kept as the
     # numerics oracle and for meshes where the einsum's all-to-all
-    # lowering is preferred.
+    # lowering is preferred. "alltoall": the explicit manual-region
+    # exchange over the ``expert`` mesh axis (moe/dispatch.py) — same
+    # routing and combine semantics, but the collective is a real,
+    # measurable jax.lax.all_to_all instead of whatever SPMD infers.
     dispatch: str = "scatter"
+    # Mesh for the alltoall path (None -> the ambient default mesh, which
+    # the engine registers at construction).
+    mesh: Any = None
+    # When True, __call__ returns (y, aux, stats) with the moe/* gauge
+    # scalars (load_balance_loss, capacity_overflow_frac,
+    # expert_utilization, dispatch_bytes_ici) so the engine's MoE monitor
+    # can flush them without retracing.
+    stats: bool = False
 
     @property
     def d_ff(self) -> int:
@@ -128,9 +139,44 @@ class MoE(nn.Module):
             xin = jnp.einsum("tec,td->ecd", dispatch.astype(cfg.dtype), hc)
             xout = expert_ffn(xin)
             y = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), xout)
+        elif cfg.dispatch == "alltoall":
+            # Explicit manual-region exchange over the expert axis —
+            # same routing/combine (exact oracle parity), real collective.
+            from deepspeed_tpu.moe.dispatch import alltoall_dispatch
+            y = alltoall_dispatch(hc, rounds, w_in, w_out,
+                                  capacity=capacity, dtype=cfg.dtype,
+                                  mesh=cfg.mesh)
         else:
             raise ValueError(f"unknown MoE dispatch '{cfg.dispatch}'")
-        return y.reshape(b, s, d), aux
+        y = y.reshape(b, s, d)
+        if cfg.stats:
+            return y, aux, _dispatch_stats(cfg, rounds, e, capacity,
+                                           tokens, d, aux)
+        return y, aux
+
+
+def _dispatch_stats(cfg, rounds, e, capacity, tokens, d, aux):
+    """The moe/* gauge scalars, computed from the routing rounds every
+    dispatch mode shares (telemetry/moe.py names; each a 0-dim fp32)."""
+    kept = sum(jnp.sum(r.keep.astype(jnp.float32)) for r in rounds)
+    counts = sum(jnp.sum(jax.nn.one_hot(r.choice, e, dtype=jnp.float32)
+                         * r.keep[:, None].astype(jnp.float32), axis=0)
+                 for r in rounds)
+    if cfg.dispatch == "alltoall":
+        from deepspeed_tpu.moe.dispatch import modeled_dispatch_bytes_ici
+        wire = modeled_dispatch_bytes_ici(
+            num_experts=e, capacity=capacity, hidden=d, dtype=cfg.dtype,
+            mesh=cfg.mesh)
+    else:
+        wire = 0  # implicit reshards are XLA's business — not modeled
+    return {
+        "load_balance_loss": aux.astype(jnp.float32),
+        "capacity_overflow_frac":
+            1.0 - kept / jnp.float32(tokens * len(rounds)),
+        "expert_utilization":
+            jnp.mean((counts > 0).astype(jnp.float32)),
+        "dispatch_bytes_ici": jnp.float32(wire),
+    }
 
 
 class _Round:

@@ -224,6 +224,16 @@ def data_like_axes(mesh: Mesh) -> tuple:
     return axes or (DATA_AXIS,)
 
 
+def moe_dispatch_axes(mesh: Mesh) -> tuple:
+    """Manual axes of the explicit MoE dispatch region (moe/dispatch.py):
+    the data-like token axes plus ``expert``. Tokens are sharded over the
+    full tuple inside the region — expert parallelism is carved out of
+    the data-parallel world, exactly the reference's expert process
+    groups — and the all-to-all runs over ``expert`` within each
+    data-like column."""
+    return data_like_axes(mesh) + (EXPERT_AXIS,)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

@@ -373,7 +373,13 @@ class TPUEngine:
             self.numerics = build_numerics(
                 config.telemetry, params_template=params,
                 compute_dtype=(self.precision.dtype if self.precision.mixed
-                               else None))
+                               else None),
+                # MoE: expert-stacked FFN leaves additionally report
+                # per-expert moe_expert_* group rows (router collapse
+                # shows up as one expert's norms flatlining).
+                expert_groups=(config.moe.num_experts
+                               if getattr(config, "moe", None) is not None
+                               and config.moe.enabled else 0))
         elif (config.telemetry.enabled
               and config.telemetry.numerics.enabled):
             log_dist(
@@ -381,6 +387,14 @@ class TPUEngine:
                 "grads inside their own manual region — in-program "
                 "statistics are unavailable on this path; numerics "
                 "observatory disabled", ranks=[0])
+
+        # --- MoE observatory (telemetry/moe.py) -----------------------------
+        # Built BEFORE the step functions: the standard builders consult
+        # it to thread the model's moe_* aux keys through the GAS scan.
+        # None (moe or telemetry off) => the builders emit bit-identical
+        # pre-moe programs. Telemetry attaches later, like numerics.
+        from deepspeed_tpu.telemetry.moe import build_moe_monitor
+        self.moe_monitor = build_moe_monitor(config)
 
         # --- ZeRO++ param gather plan (after numerics: the plan measures
         # the lossy wire hop only when the observatory is listening) -----
@@ -445,6 +459,11 @@ class TPUEngine:
             # step builders ran; the registry its flush emits into
             # exists only now.
             self.numerics.attach(self.telemetry)
+        if self.moe_monitor is not None:
+            # Same late binding for the moe/* flush point (built before
+            # the step builders, which consult it to thread the moe_*
+            # aux keys through the GAS scan).
+            self.moe_monitor.attach(self.telemetry)
         # Goodput accounting (telemetry/goodput.py): attributes every
         # wall-clock second of this attempt to a category and persists the
         # per-attempt run manifest. Disabled => None, and every hook below
@@ -1127,6 +1146,8 @@ class TPUEngine:
             lambda s: NamedSharding(mesh, s), self.grad_specs)
         scaled_loss_fn = self._make_scaled_loss_fn()
         compute_params_fn = self._make_compute_params()
+        from deepspeed_tpu.telemetry.moe import MOE_AUX_KEYS
+        moe_keys = MOE_AUX_KEYS if self.moe_monitor is not None else ()
 
         def micro_step_inner(state: TrainState, batch, compute_params):
             rng, sub = jax.random.split(state.rng)
@@ -1155,17 +1176,28 @@ class TPUEngine:
             compute_params, pqerr = compute_params_fn(state.params)
 
             def body(st, batch):
-                st, loss, _ = micro_step_inner(st, batch, compute_params)
-                return st, loss
+                st, loss, m_aux = micro_step_inner(st, batch, compute_params)
+                # MoE: thread the model's in-program moe_* stats out of
+                # the scan (trace-time key check — a moe-less model, or
+                # moe_monitor None, stacks nothing and the emitted
+                # program is bit-identical to the pre-moe one).
+                moe = ({k: m_aux[k] for k in moe_keys if k in m_aux}
+                       if moe_keys and isinstance(m_aux, dict) else {})
+                return st, (loss, moe)
 
-            state, losses = jax.lax.scan(body, state, batches)
+            state, (losses, moe_stacked) = jax.lax.scan(body, state, batches)
             out = apply_step(state, lr)
             state, overflow, norm = out[0], out[1], out[2]
+            step_aux = {}
             if self.numerics is not None:
-                aux = {"groups": out[3]}
+                step_aux["groups"] = out[3]
                 if pqerr is not None:
-                    aux["param_qerr"] = pqerr
-                return state, jnp.mean(losses), overflow, norm, aux
+                    step_aux["param_qerr"] = pqerr
+            if moe_stacked:
+                step_aux["moe"] = {k: jnp.mean(v.astype(jnp.float32))
+                                   for k, v in moe_stacked.items()}
+            if step_aux:
+                return state, jnp.mean(losses), overflow, norm, step_aux
             return state, jnp.mean(losses), overflow, norm
 
         def eval_step(state: TrainState, batch):
@@ -1844,6 +1876,11 @@ class TPUEngine:
                 # fleet gather so its grad_norm field reads this flush's
                 # value.
                 self.numerics.flush(self.global_steps)
+            if self.moe_monitor is not None:
+                # Same economy: ONE device_get of the step's moe_* aux
+                # refs, then the moe/* gauge family — inside the cadence
+                # block so the step path never pays the fetch.
+                self.moe_monitor.flush()
             tel.flush()
             if self.goodput is not None:
                 # Crash-freshness: a SIGTERM'd attempt keeps a manifest no
@@ -2136,10 +2173,19 @@ class TPUEngine:
             out = self._train_step(self.state, batches, lr)
         self.state, loss, overflow, norm = out[:4]
         self.global_steps += 1
+        step_aux = out[4] if len(out) > 4 else {}
         if self.numerics is not None:
             # A reference hand-off of the in-program stats aux — the
             # device->host transfer happens at the flush boundary only.
-            self.numerics.note_step(out[4], self.global_steps)
+            self.numerics.note_step(
+                {k: v for k, v in step_aux.items() if k != "moe"},
+                self.global_steps)
+        if self.moe_monitor is not None and "moe" in step_aux:
+            # Same reference hand-off for the model's moe_* stats; the
+            # monitor pays its one device_get at the flush boundary.
+            self.moe_monitor.note_step(
+                step_aux["moe"], self.global_steps,
+                gas=self.gradient_accumulation_steps)
         self.micro_steps += self.gradient_accumulation_steps
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()

@@ -35,7 +35,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from deepspeed_tpu.parallel.mesh import (DATA_AXIS, DCN_AXIS,
+from deepspeed_tpu.parallel.mesh import (DATA_AXIS, DCN_AXIS, EXPERT_AXIS,
                                          axes_size as mesh_axes_size)
 from deepspeed_tpu.runtime.zero.config import ZeroConfig
 
@@ -177,7 +177,19 @@ class ZeroPartitioner:
         intra-slice (data,) partition, NOT to full replication — plain
         stage 3 sharded such leaves over data and the "maximal HBM
         savings" mode can never do worse; the leaf then behaves like an
-        hpZ leaf (data-sharded, dcn-replicated, ICI-only gather)."""
+        hpZ leaf (data-sharded, dcn-replicated, ICI-only gather).
+
+        Expert-stacked leaves (a base spec placing the ``expert`` axis —
+        moe_partition_rules) are ALWAYS kept intra-slice: expert params
+        are the all-to-all dispatch path's working set every microstep,
+        and a dcn-spanning primary would put their gather on the
+        cross-slice wire. They take the hpZ treatment unconditionally —
+        (data,) on the free dim, dcn-replicated, ICI-only collectives —
+        which tests/test_moe.py pins at the spec and jaxpr level."""
+        if base_spec is not None and self._places(base_spec,
+                                                 (EXPERT_AXIS,)):
+            return self._shard_spec(shape, base_spec, (DATA_AXIS,),
+                                    min_size=min_size)
         spec = self._shard_spec(shape, base_spec, self.primary_axes,
                                 min_size=min_size)
         if len(self.primary_axes) > 1 \

@@ -103,7 +103,7 @@ class NumericsPlan:
     """
 
     def __init__(self, params_template: Any, max_groups: int = 16,
-                 compute_dtype=None):
+                 compute_dtype=None, expert_groups: int = 0):
         import jax
 
         flat, _ = jax.tree_util.tree_flatten_with_path(params_template)
@@ -123,6 +123,32 @@ class NumericsPlan:
         other = index.get(OTHER_GROUP)
         self.leaf_group = [index.get(k, other) for k in keys]
         self.num_groups = len(self.group_names)
+        # MoE: per-expert rows. Expert-stacked FFN leaves (leading dim ==
+        # expert_groups, last path key experts_in/experts_out — the
+        # moe/layer.py param layout) ALSO contribute one row per expert,
+        # appended after the regular groups and exempt from max_groups
+        # (they are a fixed-size family, not a pytree-shaped one). Each
+        # such leaf still feeds its top-level group so the regular rows
+        # stay comparable across MoE/dense runs. expert_groups == 0 (the
+        # default, and any moe-less engine) leaves the plan byte-identical
+        # — the zero-overhead contract.
+        self.expert_groups = int(expert_groups)
+        self.expert_leaf_idx: List[int] = []
+        self.expert_base = self.num_groups
+        if self.expert_groups > 0:
+            for li, (path, leaf) in enumerate(flat):
+                last = path[-1]
+                name = str(getattr(last, "key", getattr(
+                    last, "name", getattr(last, "idx", last))))
+                shape = getattr(leaf, "shape", ())
+                if (name in ("experts_in", "experts_out")
+                        and len(shape) >= 1
+                        and int(shape[0]) == self.expert_groups):
+                    self.expert_leaf_idx.append(li)
+            if self.expert_leaf_idx:
+                self.group_names = list(self.group_names) + [
+                    f"moe_expert_{i}" for i in range(self.expert_groups)]
+                self.num_groups = len(self.group_names)
         # Saturation/underflow are measured against this dtype (the
         # engine's mixed-precision compute dtype); None ⇒ pure-fp32 run,
         # counters are structurally zero.
@@ -172,6 +198,39 @@ class NumericsPlan:
                 sat = under = zero
             stats = stats.at[gid].add(
                 jnp.stack([jnp.sum(g32 * g32), w_sq, u_sq, sat, under]))
+        # MoE per-expert rows: expert-stacked leaves additionally reduce
+        # over all-but-the-leading axis and scatter into the appended
+        # moe_expert_* rows (disjoint from the top-level rows above).
+        for i in getattr(self, "expert_leaf_idx", ()):
+            e = self.expert_groups
+            g32 = g_leaves[i].astype(jnp.float32)
+            if inv_scale is not None:
+                g32 = g32 * inv_scale
+            gf = g32.reshape(e, -1)
+            g_sq = jnp.sum(gf * gf, axis=1)
+            p = p_leaves[i]
+            if p is not None:
+                pf = p.astype(jnp.float32).reshape(e, -1)
+                w_sq = jnp.sum(pf * pf, axis=1)
+            else:
+                pf = None
+                w_sq = jnp.zeros((e,), jnp.float32)
+            if n_leaves[i] is not None and pf is not None:
+                df = n_leaves[i].astype(jnp.float32).reshape(e, -1) - pf
+                u_sq = jnp.sum(df * df, axis=1)
+            else:
+                u_sq = jnp.zeros((e,), jnp.float32)
+            if cdt is not None and jnp.dtype(cdt) != jnp.float32:
+                gc = gf.astype(cdt)
+                sat = jnp.sum(((~jnp.isfinite(gc)) & jnp.isfinite(gf))
+                              .astype(jnp.float32), axis=1)
+                under = jnp.sum(((gc == 0) & (gf != 0))
+                                .astype(jnp.float32), axis=1)
+            else:
+                sat = under = jnp.zeros((e,), jnp.float32)
+            per_expert = jnp.stack([g_sq, w_sq, u_sq, sat, under], axis=1)
+            stats = stats.at[
+                self.expert_base:self.expert_base + e].add(per_expert)
         return stats
 
 
@@ -326,17 +385,21 @@ class NumericsObservatory:
         return self._last_step
 
 
-def build_numerics(tcfg, params_template: Any,
-                   compute_dtype=None) -> Optional[NumericsObservatory]:
+def build_numerics(tcfg, params_template: Any, compute_dtype=None,
+                   expert_groups: int = 0) -> Optional[NumericsObservatory]:
     """``None`` unless telemetry AND its numerics block are enabled — the
     engine hooks gate on ``is None`` (the zero-overhead contract, same
-    shape as goodput/fleet/memory/devicetime)."""
+    shape as goodput/fleet/memory/devicetime). ``expert_groups``: the
+    engine passes ``moe.num_experts`` when the moe block is enabled, so
+    expert-stacked FFN leaves get per-expert ``moe_expert_*`` rows; the
+    default 0 keeps the plan byte-identical to a moe-less engine."""
     if tcfg is None or not tcfg.enabled or not tcfg.numerics.enabled:
         return None
     try:
         plan = NumericsPlan(params_template,
                             max_groups=tcfg.numerics.max_groups,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype,
+                            expert_groups=int(expert_groups))
     except Exception as e:  # noqa: BLE001 — observability must never
         # take down the engine it observes
         logger.warning("numerics: plan construction failed: %s", e)

@@ -606,3 +606,80 @@ class TestCLISelftests:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "adopted:" in proc.stdout
         assert "default" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# MoE axes (num_experts prune-only, capacity_factor/dispatch trialable)
+# ---------------------------------------------------------------------------
+
+class TestMoEAxes:
+    MOE = {"moe": {"enabled": True, "num_experts": 8, "k": 1,
+                   "dispatch": "scatter"},
+           "mesh": {"expert": 2}}
+
+    def _cfg(self, autotuning):
+        from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+        return DeepSpeedTPUConfig(
+            {**_base_cfg(), **self.MOE, "autotuning": autotuning},
+            world_size=8)
+
+    def test_enumerate_crosses_moe_axes(self):
+        from deepspeed_tpu.autotuning import enumerate_candidates
+        cfg = self._cfg({"enabled": True, "zero_stages": [1],
+                         "moe_capacity_factors": [1.0, 1.25, 2.0],
+                         "moe_dispatch": ["scatter", "alltoall"]})
+        cands, _notes = enumerate_candidates(cfg, {"data": 4, "dcn": 1,
+                                                   "expert": 2},
+                                             world_size=8)
+        combos = {(c.moe_capacity_factor, c.moe_dispatch) for c in cands}
+        assert {(1.0, "scatter"), (1.25, "alltoall"),
+                (2.0, "alltoall")} <= combos
+        # every candidate on an MoE workload carries the moe knobs
+        assert all(c.moe_experts is not None for c in cands)
+        named = [c.name for c in cands if c.moe_dispatch == "alltoall"]
+        assert named and all("alltoall" in n and "e8" in n for n in named)
+
+    def test_axes_collapse_when_moe_off(self):
+        from deepspeed_tpu.autotuning import enumerate_candidates
+        from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+        cfg = DeepSpeedTPUConfig(
+            {**_base_cfg(),
+             "autotuning": {"enabled": True, "zero_stages": [1],
+                            "moe_dispatch": ["alltoall"]}},
+            world_size=8)
+        cands, notes = enumerate_candidates(cfg, {"data": 8, "dcn": 1},
+                                            world_size=8)
+        assert all(c.moe_experts is None and c.moe_dispatch is None
+                   for c in cands)
+        assert any("moe axes collapsed" in n for n in notes)
+
+    def test_materialize_writes_moe_block(self):
+        from deepspeed_tpu.autotuning import enumerate_candidates
+        from deepspeed_tpu.autotuning.space import materialize
+        cfg = self._cfg({"enabled": True, "zero_stages": [1],
+                         "moe_capacity_factors": [2.0],
+                         "moe_dispatch": ["alltoall"]})
+        cands, _ = enumerate_candidates(cfg, {"data": 4, "dcn": 1,
+                                              "expert": 2}, world_size=8)
+        cand = next(c for c in cands if c.moe_dispatch == "alltoall")
+        d = materialize({**_base_cfg(), **self.MOE}, cand, cfg)
+        assert d["moe"]["enabled"] is True
+        assert d["moe"]["num_experts"] == 8
+        assert d["moe"]["capacity_factor"] == 2.0
+        assert d["moe"]["dispatch"] == "alltoall"
+        # the untouched knobs survive (k from the base block)
+        assert d["moe"]["k"] == 1
+
+    def test_invalid_expert_count_pruned_by_config_parse(self):
+        """Stage-1 pruning IS the ordinary config validation: an expert
+        count the mesh can't shard fails the parse, costing nothing."""
+        from deepspeed_tpu.autotuning.space import Candidate, materialize
+        from deepspeed_tpu.config.config import (ConfigError,
+                                                 DeepSpeedTPUConfig)
+        cfg = self._cfg({"enabled": True})
+        cand = Candidate(name="bad", zero_stage=1, micro=2, gas=4,
+                         moe_experts=5, moe_capacity_factor=1.25,
+                         moe_dispatch="scatter")
+        d = materialize({**_base_cfg(), **self.MOE}, cand, cfg)
+        with pytest.raises(ConfigError, match="num_experts"):
+            DeepSpeedTPUConfig(d, world_size=8)

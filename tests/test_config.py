@@ -165,3 +165,65 @@ def test_communication_data_type_invalid_raises():
         DeepSpeedTPUConfig(
             {"train_batch_size": 8, "communication_data_type": "int7"},
             world_size=8)
+
+
+def test_moe_block_defaults():
+    c = DeepSpeedTPUConfig(
+        {"train_batch_size": 8, "mesh": {"expert": 2}, "moe": {}},
+        world_size=8)
+    # presence of the block opts in
+    assert c.moe.enabled
+    assert c.moe.num_experts == 8 and c.moe.k == 1
+    assert c.moe.capacity_factor == 1.25
+    assert c.moe.eval_capacity_factor == 2.0
+    assert c.moe.dispatch == "scatter"
+    # absence keeps it off (the zero-overhead contract's config half)
+    assert not DeepSpeedTPUConfig({"train_batch_size": 8},
+                                  world_size=8).moe.enabled
+
+
+@pytest.mark.parametrize("block,match", [
+    ({"num_experts": 1}, "num_experts"),
+    ({"k": 3}, "moe.k"),
+    ({"layer_freq": 0}, "layer_freq"),
+    ({"capacity_factor": 0}, "capacity"),
+    ({"eval_capacity_factor": -1}, "capacity"),
+    ({"min_capacity": 0}, "min_capacity"),
+    ({"aux_alpha": -0.1}, "aux_alpha"),
+    ({"router_jitter": 1.5}, "router_jitter"),
+    ({"dispatch": "magic"}, "dispatch"),
+])
+def test_moe_block_invalid_raises(block, match):
+    with pytest.raises(ConfigError, match=match):
+        DeepSpeedTPUConfig({"train_batch_size": 8, "moe": block},
+                           world_size=8)
+
+
+def test_moe_expert_axis_divisibility_raises():
+    with pytest.raises(ConfigError, match="num_experts"):
+        DeepSpeedTPUConfig(
+            {"train_batch_size": 8, "mesh": {"expert": 4},
+             "moe": {"num_experts": 6}}, world_size=8)
+
+
+@pytest.mark.parametrize("extra,match", [
+    ({"pipeline": {"stages": 2}, "zero_optimization": {"stage": 1}},
+     "pipeline"),
+    ({"zero_optimization": {"stage": 2,
+                            "offload_optimizer": {"device": "cpu"}}},
+     "offload"),
+    ({"optimizer": {"type": "OneBitAdam", "params": {"lr": 1e-3}}},
+     "1-bit"),
+])
+def test_moe_composition_walls(extra, match):
+    with pytest.raises(ConfigError, match=match):
+        DeepSpeedTPUConfig(
+            {"train_batch_size": 8, "mesh": {"expert": 2},
+             "moe": {"num_experts": 4}, **extra}, world_size=8)
+
+
+def test_moe_disabled_block_composes_freely():
+    c = DeepSpeedTPUConfig(
+        {"train_batch_size": 8, "moe": {"enabled": False},
+         "pipeline": {"stages": 2}}, world_size=8)
+    assert not c.moe.enabled

@@ -24,6 +24,7 @@ from deepspeed_tpu.telemetry.devicetime import DEVICETIME_METRIC_TAGS
 from deepspeed_tpu.telemetry.fleet import FLEET_METRIC_TAGS
 from deepspeed_tpu.telemetry.goodput import GOODPUT_METRIC_TAGS
 from deepspeed_tpu.telemetry.memory import MEMORY_METRIC_TAGS
+from deepspeed_tpu.telemetry.moe import MOE_METRIC_TAGS
 from deepspeed_tpu.telemetry.numerics import NUMERICS_METRIC_TAGS
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -44,6 +45,10 @@ _COMM_PARAMS_TOKEN_RE = re.compile(r"comm/[A-Za-z_]+_params")
 _ELASTIC_TOKEN_RE = re.compile(r"\belastic/[A-Za-z_]+")
 # \b so "autotuning/" (the package path) never false-positives
 _AUTOTUNE_TOKEN_RE = re.compile(r"\bautotune/[A-Za-z_]+")
+# "moe/" is ALSO the package path (moe/layer.py, moe/dispatch.py), so a
+# token followed by a dot/slash/word char (a file or module reference)
+# is not a metric tag.
+_MOE_TOKEN_RE = re.compile(r"\bmoe/[A-Za-z_]+(?![\w./])")
 
 
 def _iter_py_files():
@@ -272,6 +277,30 @@ class TestDocDrift:
         # enforcement
         assert "goodput/autotune_search_sec" in GOODPUT_METRIC_TAGS
         assert "goodput/autotune_search_sec" in doc
+
+    def test_moe_tags_documented_and_vice_versa(self):
+        """The MoE observatory surface (telemetry/moe.py) is pinned in
+        BOTH directions like goodput/fleet/numerics: every tag the
+        monitor can emit — the four moe/* gauges — must be in the doc,
+        and every moe/* metric token the doc names (file references like
+        moe/layer.py are screened by the regex) must be one the code
+        emits."""
+        doc = _doc_text()
+        undocumented = sorted(t for t in MOE_METRIC_TAGS if t not in doc)
+        assert not undocumented, undocumented
+        doc_tokens = set(_MOE_TOKEN_RE.findall(doc))
+        phantom = sorted(t for t in doc_tokens
+                         if t not in MOE_METRIC_TAGS)
+        assert not phantom, (
+            f"docs/OBSERVABILITY.md names moe tags the code never "
+            f"emits: {phantom}")
+        # the monitor's computed emission ("moe/" + aux suffix) must map
+        # exactly onto the declared tag set — a renamed aux key would
+        # silently drop a gauge otherwise
+        from deepspeed_tpu.telemetry.moe import MOE_AUX_KEYS
+        derived = {"moe/" + k[len("moe_"):] for k in MOE_AUX_KEYS}
+        assert derived == set(MOE_METRIC_TAGS), (
+            derived ^ set(MOE_METRIC_TAGS))
 
     def test_autotune_report_tags_in_sync(self):
         """tools/autotune_report.py is stdlib-only by design (no package

@@ -53,6 +53,8 @@ NOISE_FLOORS = {
     "long16k": 0.12,
     "inference": 0.10,
     "serving": 0.15,
+    # dispatch A/B: tiny model, few steps per window -> coarse timing
+    "moe_gpt": 0.12,
 }
 DEFAULT_FLOOR = 0.10
 
@@ -249,6 +251,27 @@ def _selftest() -> int:
     assert "numerics_probe/flush_fetch_ms" in rep["new_metrics"], rep
     text_added = render(rep)
     assert "new in candidate" in text_added and "GATE: ok" in text_added
+    # 4c. the moe_gpt dispatch A/B section (bench.py sec_moe_gpt): new
+    #     against an old baseline it is informational; once adopted, its
+    #     step-time rows gate in the latency direction (a slower
+    #     all-to-all is a regression even though the number went UP) and
+    #     the static dispatch-bytes row gates as throughput-shaped only
+    #     on real change.
+    moe_rows = {"step_time_einsum_ms": 80.0, "step_time_scatter_ms": 75.0,
+                "step_time_alltoall_ms": 70.0,
+                "alltoall_vs_scatter_speedup": 1.07,
+                "dispatch_bytes_ici_per_layer": 166400.0,
+                "capacity_overflow_frac": 0.10}
+    with_moe = {"sections": {**baseline["sections"], "moe_gpt": moe_rows}}
+    rep = compare(baseline, with_moe)
+    assert rep["ok"], rep
+    assert "moe_gpt/step_time_alltoall_ms" in rep["new_metrics"], rep
+    moe_base = {"sections": {"moe_gpt": moe_rows}}
+    slow_a2a = {"sections": {"moe_gpt": {
+        **moe_rows, "step_time_alltoall_ms": 95.0}}}
+    rep = compare(moe_base, slow_a2a)
+    bad = [r for r in rep["rows"] if r["verdict"] == "REGRESSION"]
+    assert len(bad) == 1 and bad[0]["metric"] == "step_time_alltoall_ms", rep
     # 5. legacy flat-key bench JSONs map onto sections
     legacy = sections_of({"value": 532.98, "gpt2_tokens_per_sec": 147691.0,
                           "serving_ttft_p50_ms": 9.1, "metric": "x",
