@@ -277,12 +277,27 @@ SERVING_BENCH_CFG = {
     "max_model_len": 112,
 }
 
+# Request-observatory config for the serving section
+# (telemetry/requests.py): sources the TPOT/e2e percentile rows from the
+# real per-request accounting surface. Recorded in the environment block
+# like SERVING_BENCH_CFG so the latency rows stay attributable.
+SERVING_REQUESTS_CFG = {
+    "enabled": True,
+    "window_sec": 10.0,
+}
+
 
 def bench_serving(n_requests=12):
-    """Offline serving throughput + TTFT through the continuous-batching
-    engine (serving/engine.py, docs/SERVING.md): a fixed mixed trace of
-    prompt/output lengths submitted up front, measured to drain. Returns
-    (tokens/s, ttft p50 ms, ttft p99 ms, mean occupancy)."""
+    """Offline serving throughput + latency SLOs through the
+    continuous-batching engine (serving/engine.py, docs/SERVING.md): a
+    fixed mixed trace of prompt/output lengths submitted up front,
+    measured to drain. TTFT comes from the engine's histogram; TPOT/e2e
+    come from the request observatory (telemetry/requests.py) enabled
+    per SERVING_REQUESTS_CFG. Returns (tokens/s, ttft p50 ms,
+    ttft p99 ms, mean occupancy, tpot p50 ms, tpot p99 ms, e2e p99
+    ms)."""
+    import tempfile
+
     import deepspeed_tpu
     from deepspeed_tpu.models import make_gpt
 
@@ -291,43 +306,54 @@ def bench_serving(n_requests=12):
     params = model.init({"params": jax.random.PRNGKey(0),
                          "dropout": jax.random.PRNGKey(1)},
                         {"input_ids": np.zeros((1, 8), np.int32)})["params"]
-    srv = deepspeed_tpu.init_serving(
-        model, params=params,
-        config={"serving": SERVING_BENCH_CFG,
-                # memory-sink metrics: the TTFT histogram percentiles come
-                # from the real telemetry surface, nothing lands on disk
-                "telemetry": {"enabled": True, "dir": ".",
-                              "metrics": {"sinks": ["memory"]},
-                              "trace": {"enabled": False}}})
-    prompts = [rng.integers(0, cfg.vocab_size,
-                            (int(rng.integers(6, 48)),)).tolist()
-               for _ in range(n_requests)]
-    outs = [int(rng.integers(8, 48)) for _ in range(n_requests)]
-    # warmup: compile the decode program AND every prefill bucket the
-    # trace will hit off the clock (one representative prompt per
-    # bucket), so the timed window measures the serving machinery, not
-    # XLA compile latency
-    seen = set()
-    for p in prompts:
-        b = srv._bucket_of(len(p))
-        if b not in seen:
-            seen.add(b)
-            srv.submit(p, 2)
-    srv.run_until_complete()
-    srv.results.clear()
-    # drop warmup observations: the compile-latency TTFTs and warmup
-    # decode steps must not leak into the reported percentiles/occupancy
-    srv.telemetry.registry.histogram("serving/ttft_ms").reset()
-    srv.stats.update(decode_steps=0, occupancy_sum=0.0,
-                     slot_assignments={})
-    t0 = time.perf_counter()
-    for p, n in zip(prompts, outs):
-        srv.submit(p, n)
-    srv.run_until_complete()
-    dt = time.perf_counter() - t0
-    hist = srv.telemetry.registry.histogram("serving/ttft_ms")
-    return (sum(outs) / dt, hist.percentile(50), hist.percentile(99),
-            srv.mean_occupancy)
+    # memory-sink metrics: the latency percentiles come from the real
+    # telemetry surface; the request records land in a throwaway dir.
+    with tempfile.TemporaryDirectory() as td:
+        srv = deepspeed_tpu.init_serving(
+            model, params=params,
+            config={"serving": SERVING_BENCH_CFG,
+                    "telemetry": {"enabled": True, "dir": td,
+                                  "metrics": {"sinks": ["memory"]},
+                                  "trace": {"enabled": False},
+                                  "requests": dict(SERVING_REQUESTS_CFG)}})
+        prompts = [rng.integers(0, cfg.vocab_size,
+                                (int(rng.integers(6, 48)),)).tolist()
+                   for _ in range(n_requests)]
+        outs = [int(rng.integers(8, 48)) for _ in range(n_requests)]
+        # warmup: compile the decode program AND every prefill bucket the
+        # trace will hit off the clock (one representative prompt per
+        # bucket), so the timed window measures the serving machinery,
+        # not XLA compile latency
+        seen = set()
+        for p in prompts:
+            b = srv._bucket_of(len(p))
+            if b not in seen:
+                seen.add(b)
+                srv.submit(p, 2)
+        srv.run_until_complete()
+        srv.results.clear()
+        # drop warmup observations: the compile-latency TTFTs/TPOTs and
+        # warmup decode steps must not leak into the reported
+        # percentiles/occupancy
+        reg = srv.telemetry.registry
+        for tag in ("serving/ttft_ms", "requests/tpot_ms",
+                    "requests/e2e_ms", "requests/queue_wait_ms"):
+            reg.histogram(tag).reset()
+        srv.stats.update(decode_steps=0, occupancy_sum=0.0,
+                         slot_assignments={})
+        t0 = time.perf_counter()
+        for p, n in zip(prompts, outs):
+            srv.submit(p, n)
+        srv.run_until_complete()
+        dt = time.perf_counter() - t0
+        hist = reg.histogram("serving/ttft_ms")
+        tpot = reg.histogram("requests/tpot_ms")
+        e2e = reg.histogram("requests/e2e_ms")
+        out = (sum(outs) / dt, hist.percentile(50), hist.percentile(99),
+               srv.mean_occupancy, tpot.percentile(50),
+               tpot.percentile(99), e2e.percentile(99))
+        srv.close()
+    return out
 
 
 def bench_serving_fastpath():
@@ -654,6 +680,9 @@ def main():
         # Its memory-sink telemetry is scoped to the serving engine and
         # never touches the training sections' timed windows.
         "serving": dict(SERVING_BENCH_CFG),
+        # Request observatory (telemetry/requests.py) behind the serving
+        # section's tpot_p50_ms/tpot_p99_ms/e2e_p99_ms rows.
+        "requests": dict(SERVING_REQUESTS_CFG),
     }
 
     if on_tpu:
@@ -776,13 +805,18 @@ def main():
         # Continuous-batching serving row (tiny GPT, CPU-runnable): the
         # serving machinery's offline throughput + TTFT SLO percentiles.
         t0 = time.time()
-        tps, p50, p99, occ = bench_serving()
+        tps, p50, p99, occ, tpot50, tpot99, e2e99 = bench_serving()
         log(f"[bench] serving (tiny GPT, {SERVING_BENCH_CFG['max_batch_size']}"
             f" slots): {tps:.1f} tok/s, TTFT p50 {p50:.1f} ms / p99 "
-            f"{p99:.1f} ms, occupancy {occ:.1%} ({time.time() - t0:.0f}s)")
+            f"{p99:.1f} ms, TPOT p50 {tpot50:.1f} ms / p99 {tpot99:.1f} ms, "
+            f"e2e p99 {e2e99:.1f} ms, occupancy {occ:.1%} "
+            f"({time.time() - t0:.0f}s)")
         result["serving_tokens_per_sec"] = round(tps, 1)
         result["serving_ttft_p50_ms"] = round(p50, 2)
         result["serving_ttft_p99_ms"] = round(p99, 2)
+        result["serving_tpot_p50_ms"] = round(tpot50, 3)
+        result["serving_tpot_p99_ms"] = round(tpot99, 3)
+        result["serving_e2e_p99_ms"] = round(e2e99, 2)
         result["serving_mean_occupancy"] = round(occ, 4)
         # decode fast path A/B (docs/SERVING.md): gather-vs-kernel decode
         # step, cold-vs-warm-head TTFT, speculative accept evidence — all
@@ -799,10 +833,15 @@ def main():
             f"({time.time() - t0:.0f}s)")
         for key, val in fp.items():
             result[f"serving_{key}"] = val
+        # tpot/e2e rows are `*_ms`, so bench_gate treats them as
+        # lower-is-better automatically (latency regresses upward).
         _section_rows(result, "serving",
                       tokens_per_sec=result["serving_tokens_per_sec"],
                       ttft_p50_ms=result["serving_ttft_p50_ms"],
                       ttft_p99_ms=result["serving_ttft_p99_ms"],
+                      tpot_p50_ms=result["serving_tpot_p50_ms"],
+                      tpot_p99_ms=result["serving_tpot_p99_ms"],
+                      e2e_p99_ms=result["serving_e2e_p99_ms"],
                       mean_occupancy=result["serving_mean_occupancy"],
                       **fp)
 

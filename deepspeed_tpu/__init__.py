@@ -359,7 +359,7 @@ def init_serving(model=None, config=None, **kwargs):
 
     from deepspeed_tpu.config.config import ServingConfig, TelemetryConfig
     from deepspeed_tpu.serving.engine import ServeEngine
-    from deepspeed_tpu.telemetry import build_telemetry
+    from deepspeed_tpu.telemetry import build_requests, build_telemetry
 
     if isinstance(config, str):
         with open(config) as f:
@@ -371,9 +371,12 @@ def init_serving(model=None, config=None, **kwargs):
     engine = init_inference(model, tracer=tel.tracer, **kwargs)
     # telemetry.numerics opt-in gates the per-prefill int8 KV-cache
     # round-trip-error gauge (docs/OBSERVABILITY.md "Numerics
-    # observatory") — telemetry-only deployments pay nothing extra.
+    # observatory"); telemetry.requests gates the per-request SLO
+    # accountant (docs/OBSERVABILITY.md "Request observatory") —
+    # telemetry-only deployments pay nothing extra for either.
     return ServeEngine(engine, config=scfg, telemetry=tel,
-                       measure_kv_quant_error=tcfg.numerics.enabled)
+                       measure_kv_quant_error=tcfg.numerics.enabled,
+                       request_accountant=build_requests(tcfg, tel))
 
 
 __all__ = [

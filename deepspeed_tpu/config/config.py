@@ -860,6 +860,49 @@ class TelemetryNumericsConfig:
 
 
 @dataclass
+class TelemetryRequestsConfig:
+    """Request observatory knobs (telemetry/requests.py): per-request SLO
+    accounting for the serve engine — exact lifetime partition, TPOT/e2e
+    histograms, host-scoped ``requests.<host>.jsonl`` records, the
+    engine-side serving-time partition, and the rolling decode-throughput
+    window behind ``serving/tokens_per_sec_window``. Default off — the
+    engine then holds no accountant (``None``) and its emitted tag set is
+    byte-identical; enabled, every hook is host ``time.monotonic``
+    arithmetic (zero device syncs)."""
+
+    enabled: bool = C.TELEMETRY_REQUESTS_ENABLED_DEFAULT
+    file: str = C.TELEMETRY_REQUESTS_FILE_DEFAULT
+    window_sec: float = C.TELEMETRY_REQUESTS_WINDOW_SEC_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> \
+            "TelemetryRequestsConfig":
+        d = d or {}
+        cfg = cls(
+            enabled=bool(_get(d, C.TELEMETRY_REQUESTS_ENABLED,
+                              C.TELEMETRY_REQUESTS_ENABLED_DEFAULT)),
+            file=str(_get(d, C.TELEMETRY_REQUESTS_FILE,
+                          C.TELEMETRY_REQUESTS_FILE_DEFAULT)),
+            window_sec=float(_get(
+                d, C.TELEMETRY_REQUESTS_WINDOW_SEC,
+                C.TELEMETRY_REQUESTS_WINDOW_SEC_DEFAULT)),
+        )
+        # Records are discovered by pattern by the stdlib-only slo_report
+        # (same argument as memory.plan_file / fleet.breakdown_file).
+        if not (cfg.file.startswith("requests")
+                and cfg.file.endswith(".jsonl")):
+            raise ConfigError(
+                "telemetry.requests.file must match 'requests*.jsonl' "
+                f"(tools/slo_report.py discovers records by that pattern), "
+                f"got '{cfg.file}'")
+        if cfg.window_sec <= 0:
+            raise ConfigError(
+                f"telemetry.requests.window_sec must be positive, got "
+                f"{cfg.window_sec}")
+        return cfg
+
+
+@dataclass
 class TelemetryConfig:
     """Unified observability (telemetry/; docs/OBSERVABILITY.md): metrics
     registry + Chrome-trace step tracer + recompilation detector. Disabled
@@ -893,6 +936,11 @@ class TelemetryConfig:
     # gauges. Opt-in (adds in-program stat reductions to the step).
     numerics: TelemetryNumericsConfig = field(
         default_factory=TelemetryNumericsConfig)
+    # Request observatory (telemetry/requests.py): per-request SLO
+    # accounting + serving-time partition for the serve engine. Opt-in
+    # (host clock arithmetic per step + one record per finished request).
+    requests: TelemetryRequestsConfig = field(
+        default_factory=TelemetryRequestsConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
@@ -914,6 +962,8 @@ class TelemetryConfig:
                 d.get(C.TELEMETRY_DEVICETIME)),
             numerics=TelemetryNumericsConfig.from_dict(
                 d.get(C.TELEMETRY_NUMERICS)),
+            requests=TelemetryRequestsConfig.from_dict(
+                d.get(C.TELEMETRY_REQUESTS)),
         )
         if cfg.enabled and not cfg.dir:
             raise ConfigError(

@@ -255,6 +255,21 @@ TELEMETRY_NUMERICS_MAX_GROUPS = "max_groups"
 TELEMETRY_NUMERICS_MAX_GROUPS_DEFAULT = 16        # top-level key cap
 TELEMETRY_NUMERICS_MAX_SPIKE_DUMPS = "max_spike_dumps"
 TELEMETRY_NUMERICS_MAX_SPIKE_DUMPS_DEFAULT = 8    # per-run dump budget
+# Request observatory (telemetry/requests.py): per-request SLO
+# accounting for the serve engine — exact lifetime partition, TPOT/e2e
+# histograms, host-scoped requests.<host>.jsonl records, an engine-side
+# serving-time partition, and the rolling decode-throughput window.
+# Default OFF: enabled it adds host float arithmetic per step (no device
+# syncs) plus one JSONL append per finished request — explicit opt-in
+# like fleet/memory, and the off state keeps the engine's emitted tag
+# set byte-identical.
+TELEMETRY_REQUESTS = "requests"
+TELEMETRY_REQUESTS_ENABLED = "enabled"
+TELEMETRY_REQUESTS_ENABLED_DEFAULT = False
+TELEMETRY_REQUESTS_FILE = "file"
+TELEMETRY_REQUESTS_FILE_DEFAULT = "requests.jsonl"
+TELEMETRY_REQUESTS_WINDOW_SEC = "window_sec"
+TELEMETRY_REQUESTS_WINDOW_SEC_DEFAULT = 10.0  # rolling-throughput window
 
 #############################################
 # Serving (TPU-native block, no reference analogue: continuous-batching

@@ -49,6 +49,10 @@ from deepspeed_tpu.utils.logging import log_dist
 SERVING_METRIC_TAGS = frozenset({
     "serving/ttft_ms",
     "serving/tokens_per_sec",
+    # Rolling-window decode throughput (window: telemetry.requests.
+    # window_sec) — emitted only when the request accountant is on, so
+    # the tag set with telemetry.requests off stays byte-identical.
+    "serving/tokens_per_sec_window",
     "serving/batch_occupancy",
     "serving/kv_blocks_in_use",
     "serving/queue_depth",
@@ -80,7 +84,8 @@ class ServeEngine:
 
     def __init__(self, engine: InferenceEngine, config=None,
                  telemetry=None, capture_logits: bool = False,
-                 measure_kv_quant_error: bool = False):
+                 measure_kv_quant_error: bool = False,
+                 request_accountant=None):
         from deepspeed_tpu.config.config import ServingConfig
         from deepspeed_tpu.telemetry import null_telemetry
 
@@ -152,6 +157,14 @@ class ServeEngine:
         self._spec_jits: Dict[Any, Any] = {}
         if self.scfg.spec_decode:
             self._init_speculative()
+        # Request observatory (telemetry/requests.py): per-request SLO
+        # ledger + engine serving-time partition. None (the default and
+        # the telemetry.requests=off state) keeps every hook a single
+        # attribute check and the emitted tag set byte-identical.
+        self._req_acc = request_accountant
+        if self._req_acc is not None:
+            self._req_acc.spec_k = self._spec_k
+            self.sched.accountant = self._req_acc
         # Numerics observatory surface (telemetry/numerics.py): with the
         # int8 KV cache AND the numerics opt-in on
         # (``telemetry.numerics.enabled`` — init_serving plumbs it;
@@ -233,7 +246,10 @@ class ServeEngine:
                 f"raise serving.kv_num_blocks")
         eos = eos_token_id if eos_token_id is not None \
             else self.scfg.eos_token_id
-        return self.sched.submit(prompt, int(max_new_tokens), eos)
+        rid = self.sched.submit(prompt, int(max_new_tokens), eos)
+        if self._req_acc is not None:
+            self._req_acc.on_submit(self.sched.waiting[-1])
+        return rid
 
     def idle(self) -> bool:
         return self.sched.idle()
@@ -247,13 +263,29 @@ class ServeEngine:
         (``finished``/``prefilled`` request ids, ``active`` count...)."""
         info: Dict[str, Any] = {"step": self._step_count, "prefilled": [],
                                 "finished": [], "active": 0}
+        # Engine serving-time partition (telemetry/requests.py): the
+        # accountant's single cursor is advanced at each phase boundary,
+        # so the step's wall clock lands in exactly one category. A step
+        # that grew a jit cache files its dispatch under "compile" (the
+        # first trace dominates that step's wall time).
+        acc = self._req_acc
+        if acc is not None:
+            acc.engine_mark("host_idle")    # since the previous step
 
         # -- admission + prefill (the in-flight batching half) ----------
         for _ in range(self.scfg.max_prefills_per_step):
             seq = self.sched.try_admit(self._bucket_of, self._step_count)
             if seq is None:
                 break
+            if acc is not None:
+                acc.engine_mark("scheduler_admission")
+                n_jits = len(self._prefill_jit) + len(self._tail_prefill_jit)
             self._prefill(seq)
+            if acc is not None:
+                grew = (len(self._prefill_jit)
+                        + len(self._tail_prefill_jit)) > n_jits
+                acc.engine_mark("compile" if grew else "prefill")
+                acc.on_prefilled(seq)
             self.sched.register_prefix(seq, self._step_count)
             info["prefilled"].append(seq.request.rid)
             self.stats["slot_assignments"].setdefault(seq.slot, 0)
@@ -270,9 +302,13 @@ class ServeEngine:
                 self.sched.ensure_capacity(seq, lookahead=self._spec_k)
         active = self.sched.active          # preemption may have evicted
         info["active"] = len(active)
+        if acc is not None:
+            acc.engine_mark("scheduler_admission")
         dt_decode = 0.0
         n_tokens = 0
         if active:
+            if acc is not None:
+                n_djits = len(self._decode_jits) + len(self._spec_jits)
             t_dec = time.perf_counter()
             if self._spec_k:
                 n_tokens = self._spec_round(active, info)
@@ -289,6 +325,13 @@ class ServeEngine:
                 if self.capture_logits:
                     info["logits"] = logits
                     info["slots"] = {s.slot: s.request.rid for s in active}
+            if acc is not None:
+                grew = (len(self._decode_jits)
+                        + len(self._spec_jits)) > n_djits
+                acc.engine_mark("compile" if grew else "decode")
+                still = [s for s in active
+                         if self.sched.running.get(s.slot) is s]
+                acc.on_decode_step(still, dt_decode, self._step_count)
             self.stats["decode_steps"] += 1
             self.stats["occupancy_sum"] += \
                 len(active) / self.scfg.max_batch_size
@@ -341,21 +384,33 @@ class ServeEngine:
 
     def _finish(self, seq: Sequence, info: Dict[str, Any]) -> None:
         rid = seq.request.rid
+        req = seq.request
         self.sched.finish(seq)
+        now = time.monotonic()
+        # Latency fields are stamped unconditionally — host floats the
+        # caller gets without telemetry enabled.
         self.results[rid] = {
             "tokens": list(seq.tokens),
-            "prompt_len": len(seq.request.prompt),
+            "prompt_len": len(req.prompt),
             "slot": seq.slot,
             "finish_step": self._step_count,
-            "ttft_ms": (seq.request.first_token_time
-                        - seq.request.arrival) * 1e3
-            if seq.request.first_token_time else None,
+            "ttft_ms": (req.first_token_time - req.arrival) * 1e3
+            if req.first_token_time else None,
+            "finish_time": now,
+            "e2e_ms": (now - req.arrival) * 1e3,
+            "queue_wait_ms": (req.admitted_time - req.arrival) * 1e3
+            if req.admitted_time is not None else None,
+            "preempted_count": req.preempted_count,
         }
         info["finished"].append(rid)
         tel = self.telemetry
         if tel.enabled:
             tel.registry.counter("serving/requests_completed").inc(
                 step=self._step_count)
+        if self._req_acc is not None:
+            slo = self._req_acc.on_finish(seq, self._step_count)
+            if slo is not None:
+                self.results[rid]["slo"] = slo
 
     # -- prefill --------------------------------------------------------
     def _prefill(self, seq: Sequence) -> None:
@@ -756,6 +811,20 @@ class ServeEngine:
             self._decode_sec += dt_decode
             reg.gauge("serving/tokens_per_sec").set(
                 self._decode_tokens / self._decode_sec, step=step)
+        # Request observatory rides here (only when the accountant is on,
+        # so the telemetry.requests=off tag set stays byte-identical):
+        # the rolling-window throughput gauge — responsive under changing
+        # load where the cumulative mean above goes stale — plus the
+        # requests/* category + engine-partition gauges.
+        acc = self._req_acc
+        if acc is not None:
+            if n_tokens and dt_decode > 0:
+                acc.rolling_add(n_tokens, dt_decode)
+            rate = acc.rolling_rate()
+            if rate is not None:
+                reg.gauge("serving/tokens_per_sec_window").set(rate,
+                                                               step=step)
+            acc.emit(step)
         pre = self.sched.preempted_total
         ctr = reg.counter("serving/preempted_seqs")
         if pre > ctr.total:
@@ -783,5 +852,8 @@ class ServeEngine:
 
     def close(self) -> None:
         """Flush AND close the telemetry this engine drives (sink file
-        handles, tracer) — init_serving hands the engine ownership."""
+        handles, tracer, request records) — init_serving hands the
+        engine ownership."""
+        if self._req_acc is not None:
+            self._req_acc.close()
         self.telemetry.close()

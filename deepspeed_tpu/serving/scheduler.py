@@ -65,6 +65,10 @@ class Request:
     # Times this request was evicted for KV pressure: a nonzero count
     # switches its re-admission to the pessimistic full-lifetime gate.
     preempted_count: int = 0
+    # Set at the request's FIRST admission and kept across preemption
+    # restarts — queue_wait is time until a slot was first granted, and
+    # results[rid] carries it even with telemetry fully off.
+    admitted_time: Optional[float] = None
 
 
 @dataclass
@@ -257,6 +261,10 @@ class Scheduler:
         self._ids = itertools.count()
         self.preempted_total = 0
         self.completed_total = 0
+        # Request observatory back-reference (telemetry/requests.py) —
+        # the engine sets it so admission/preemption mark the per-request
+        # SLO ledger without relaying through the engine. None = off.
+        self.accountant = None
 
     # -- submission -----------------------------------------------------
     def submit(self, prompt: List[int], max_new_tokens: int,
@@ -324,6 +332,10 @@ class Scheduler:
                        pos=len(req.prompt), admitted_step=step,
                        shared_len=n_shared * self.block_size)
         self.running[slot] = seq
+        if req.admitted_time is None:
+            req.admitted_time = time.monotonic()
+        if self.accountant is not None:
+            self.accountant.on_admit(seq)
         return seq
 
     def register_prefix(self, seq: Sequence, step: int) -> None:
@@ -380,6 +392,8 @@ class Scheduler:
         seq.request.preempted_count += 1
         self.waiting.appendleft(seq.request)
         self.preempted_total += 1
+        if self.accountant is not None:
+            self.accountant.on_preempt(seq)
 
     # -- completion -----------------------------------------------------
     def finish(self, seq: Sequence) -> None:

@@ -39,6 +39,11 @@ from deepspeed_tpu.telemetry.numerics import (NUMERICS_METRIC_TAGS,
                                               NumericsObservatory,
                                               NumericsPlan,
                                               build_numerics)
+from deepspeed_tpu.telemetry.requests import (ENGINE_CATEGORIES,
+                                              REQUEST_CATEGORIES,
+                                              REQUEST_METRIC_TAGS,
+                                              RequestAccountant,
+                                              build_requests)
 from deepspeed_tpu.telemetry.recompile import (RECOMPILE_COUNTER,
                                                RecompileDetector,
                                                tree_signature)
@@ -50,15 +55,17 @@ from deepspeed_tpu.telemetry.tracer import StepTracer
 
 __all__ = [
     "Counter", "DEVICETIME_METRIC_TAGS", "DeviceTimeObservatory",
-    "FLEET_METRIC_TAGS", "FleetAggregator", "Gauge",
+    "ENGINE_CATEGORIES", "FLEET_METRIC_TAGS", "FleetAggregator", "Gauge",
     "GOODPUT_CATEGORIES", "GOODPUT_METRIC_TAGS", "GoodputAccountant",
     "Histogram", "InMemorySink", "JSONLSink", "MEMORY_METRIC_TAGS",
     "MemoryObservatory", "MetricsRegistry", "NUMERICS_METRIC_TAGS",
     "NumericsObservatory", "NumericsPlan",
-    "RecompileDetector", "RECOMPILE_COUNTER", "Sink", "StepTracer",
+    "RecompileDetector", "RECOMPILE_COUNTER",
+    "REQUEST_CATEGORIES", "REQUEST_METRIC_TAGS", "RequestAccountant",
+    "Sink", "StepTracer",
     "Telemetry", "TensorboardSink", "build_devicetime", "build_fleet",
     "build_goodput", "build_memory_observatory", "build_numerics",
-    "build_telemetry",
+    "build_requests", "build_telemetry",
     "collect_memory_snapshot", "default_host", "host_scoped_path",
     "model_state_ledger", "null_telemetry", "plan_capacity",
     "telemetry_host_component", "tree_signature",

@@ -1,0 +1,422 @@
+"""Request observatory — per-request SLO accounting for the serve engine.
+
+The serving analogue of the goodput observatory (``telemetry/goodput.py``):
+where goodput partitions a TRAINING run's wall clock into an exact category
+set, the :class:`RequestAccountant` partitions every serving REQUEST's
+lifetime — arrival to finish — into
+
+    queue_wait / prefill / decode_active / preempted_requeue /
+    spec_overhead / finish_other
+
+via monotonic marks the ServeEngine and Scheduler place at submission,
+admission, prefill completion, every decode step the row is active,
+preemption/requeue, and finish. Categories sum to the measured lifetime by
+construction (each mark attributes ``now - cursor`` and advances the
+cursor), so "where did this request's latency go" is an exact statement,
+not a sampled one.
+
+Alongside the per-request ledger, the accountant keeps an **engine-side
+serving-time partition** (prefill / decode / scheduler_admission /
+host_idle / compile) over the engine's own wall clock — the per-replica
+"what fraction of serving time produced tokens" number the ROADMAP's
+scale-out router ranks replicas with.
+
+Everything here is host-side ``time.monotonic`` arithmetic: no device
+syncs, no extra ``block_until_ready``. The established zero-overhead
+off-contract applies — ``build_requests`` returns ``None`` unless
+``telemetry.requests.enabled``, every engine hook gates on ``is None``,
+and with the accountant off the engine's emitted tag set is byte-identical
+to today's.
+
+Outputs:
+
+- registry metrics under ``requests/`` (cumulative per-category seconds,
+  the engine partition, TPOT / e2e / queue-wait histograms, prefix-cache
+  token savings, preemption counts) — every tag in
+  :data:`REQUEST_METRIC_TAGS`, pinned against docs/OBSERVABILITY.md by
+  tests/test_doc_lint.py;
+- one JSONL record per finished request in host-scoped
+  ``requests.<host>.jsonl`` (single-host: ``requests.jsonl``), merged
+  across hosts by ``tools/slo_report.py``;
+- per-request async tracks in the Perfetto timeline (StepTracer ``b``/"e"
+  events) so a request's queue -> prefill -> decode -> preempt -> resume
+  arc is visible across the engine's step spans.
+"""
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+# The exact partition of one request's lifetime. ``finish_other`` absorbs
+# host-side residue (dispatch bookkeeping, the slice of a step a row spent
+# waiting on batch-mates, the final finish mark) so the sum is always the
+# measured lifetime — nothing is dropped on the floor.
+REQUEST_CATEGORIES = (
+    "queue_wait",          # submitted, waiting for a slot + blocks
+    "prefill",             # admission -> first token (cold or warm tail)
+    "decode_active",       # decode steps producing accepted tokens
+    "preempted_requeue",   # evicted for KV pressure, waiting to re-admit
+    "spec_overhead",       # speculative decode time on rejected drafts
+    "finish_other",        # host residue: dispatch, batch skew, finish
+)
+
+# The engine-side serving-time partition (one cursor over the engine's own
+# wall clock, marked inside ``ServeEngine.step``).
+ENGINE_CATEGORIES = (
+    "prefill",             # prefill dispatch + first-token fetch
+    "decode",              # decode/spec dispatch + token fetch
+    "scheduler_admission", # host scheduling: admit, growth, preemption
+    "host_idle",           # between steps (caller think time, idle loop)
+    "compile",             # steps that grew a jit cache (first traces)
+)
+
+# Every metric tag this module can emit — pinned against
+# docs/OBSERVABILITY.md in both directions by tests/test_doc_lint.py.
+REQUEST_METRIC_TAGS = frozenset(
+    {f"requests/{c}_sec" for c in REQUEST_CATEGORIES}
+    | {f"requests/engine_{c}_sec" for c in ENGINE_CATEGORIES}
+    | {
+        "requests/engine_wall_sec",
+        "requests/tpot_ms",
+        "requests/e2e_ms",
+        "requests/queue_wait_ms",
+        "requests/prefix_tokens_saved",
+        "requests/preemptions",
+    })
+
+RECORD_FORMAT = 1
+
+
+class _ReqState:
+    """Per-request mark cursor + partition ledger."""
+
+    __slots__ = ("rid", "last", "totals", "phase", "requeued", "span",
+                 "last_token", "last_generated", "tpot_sum_ms", "tpot_n",
+                 "prefix_tokens")
+
+    def __init__(self, rid: int, arrival: float):
+        self.rid = rid
+        self.last = arrival            # the mark cursor (monotonic)
+        self.totals = {c: 0.0 for c in REQUEST_CATEGORIES}
+        self.phase = "queue"
+        self.requeued = False
+        self.span: Optional[str] = None   # open async-track span name
+        self.last_token: Optional[float] = None
+        self.last_generated = 0
+        self.tpot_sum_ms = 0.0
+        self.tpot_n = 0
+        self.prefix_tokens = 0
+
+
+class RequestAccountant:
+    """Mark-based per-request SLO ledger + engine serving-time partition.
+
+    The engine owns exactly one accountant (or ``None``); the scheduler
+    holds a back-reference so admission/preemption mark without the
+    engine relaying. All hooks are pure host float arithmetic on
+    ``time.monotonic`` — no device work, ever.
+    """
+
+    def __init__(self, registry=None, tracer=None,
+                 run_dir: Optional[str] = None,
+                 file: str = "requests.jsonl",
+                 window_sec: float = 10.0,
+                 host: Optional[str] = None):
+        from deepspeed_tpu.telemetry.fleet import (default_host,
+                                                   host_scoped_path,
+                                                   telemetry_host_component)
+        self.registry = registry
+        self.tracer = tracer if (tracer is not None
+                                 and getattr(tracer, "enabled", False)) \
+            else None
+        self.window_sec = float(window_sec)
+        self.host = host if host is not None else default_host()
+        # monotonic -> wall-clock anchor, persisted per record so
+        # slo_report can order records across hosts.
+        self._wall_offset = time.time() - time.monotonic()
+        self.spec_k = 0                # engine sets when spec decode is on
+        self._states: Dict[int, _ReqState] = {}
+        # Cumulative category seconds over FINISHED requests (the
+        # ``requests/<cat>_sec`` gauges).
+        self._cat_totals = {c: 0.0 for c in REQUEST_CATEGORIES}
+        now = time.monotonic()
+        self._eng_totals = {c: 0.0 for c in ENGINE_CATEGORIES}
+        self._eng_start = now
+        self._eng_last = now
+        # Rolling decode-throughput window: (t, tokens, decode_sec).
+        self._window: deque = deque()
+        self.completed = 0
+        self.path: Optional[str] = None
+        self._fh = None
+        self._write_failed = False
+        if run_dir:
+            part = telemetry_host_component()
+            self.path = os.path.join(run_dir,
+                                     host_scoped_path(file, part))
+
+    # -- request lifecycle marks ---------------------------------------
+    def _mark(self, st: _ReqState, cat: str, now: float) -> None:
+        st.totals[cat] += now - st.last
+        st.last = now
+
+    def _trace_to(self, st: _ReqState, name: Optional[str]) -> None:
+        tr = self.tracer
+        if tr is None:
+            return
+        if st.span is not None:
+            tr.async_end(st.span, st.rid)
+        if name is not None:
+            tr.async_begin(name, st.rid, rid=st.rid)
+        st.span = name
+
+    def on_submit(self, request) -> None:
+        """The request entered the waiting queue (cursor = its arrival)."""
+        st = _ReqState(request.rid, request.arrival)
+        self._states[request.rid] = st
+        self._trace_to(st, "req/queue")
+
+    def on_admit(self, seq) -> None:
+        """Scheduler granted a slot + blocks; prefill is next. Time since
+        the cursor is queue wait — or requeue wait after a preemption."""
+        st = self._states.get(seq.request.rid)
+        if st is None:
+            return
+        now = time.monotonic()
+        self._mark(st, "preempted_requeue" if st.requeued else "queue_wait",
+                   now)
+        st.requeued = False
+        # The winning admission's adopted head (a warm restart may adopt
+        # more than the cold first admission did).
+        st.prefix_tokens = seq.shared_len
+        st.phase = "prefill"
+        self._trace_to(st, "req/prefill")
+
+    def on_prefilled(self, seq) -> None:
+        """Prefill (cold or warm-tail) produced the first token."""
+        st = self._states.get(seq.request.rid)
+        if st is None:
+            return
+        now = time.monotonic()
+        self._mark(st, "prefill", now)
+        # TPOT baseline: inter-token intervals start at the first token.
+        st.last_token = now
+        st.last_generated = seq.generated
+        st.phase = "decode"
+        self._trace_to(st, "req/decode")
+
+    def _useful_frac(self, appended: int) -> float:
+        """Fraction of a decode slice that produced accepted tokens: a
+        speculative round runs k+1 positions per row regardless of how
+        many survive the accept rule; non-speculative decode is all
+        useful."""
+        if not self.spec_k:
+            return 1.0
+        return min(1.0, appended / float(self.spec_k + 1))
+
+    def _observe_tpot(self, st: _ReqState, seq, now: float,
+                      step: int) -> int:
+        """Attribute inter-token intervals for tokens appended since the
+        last mark; returns how many were appended."""
+        appended = seq.generated - st.last_generated
+        if appended > 0 and st.last_token is not None:
+            interval_ms = (now - st.last_token) / appended * 1e3
+            st.tpot_sum_ms += interval_ms * appended
+            st.tpot_n += appended
+            if self.registry is not None:
+                hist = self.registry.histogram("requests/tpot_ms")
+                for _ in range(appended):
+                    hist.observe(interval_ms, step=step)
+        if appended > 0:
+            st.last_token = now
+        st.last_generated = seq.generated
+        return appended
+
+    def on_decode_step(self, seqs, dt_decode: float, step: int) -> None:
+        """One decode (or speculative) step advanced ``seqs`` (the rows
+        still running after the step — finished rows went through
+        :meth:`on_finish` already). Per row: the slice since its cursor
+        splits into host residue (anything beyond the measured decode
+        dispatch) and decode time, the latter apportioned between
+        ``decode_active`` and ``spec_overhead`` by the row's accepted
+        fraction."""
+        now = time.monotonic()
+        for seq in seqs:
+            st = self._states.get(seq.request.rid)
+            if st is None:
+                continue
+            appended = self._observe_tpot(st, seq, now, step)
+            elapsed = now - st.last
+            other = max(0.0, elapsed - dt_decode)
+            dec = elapsed - other
+            frac = self._useful_frac(appended)
+            st.totals["decode_active"] += dec * frac
+            st.totals["spec_overhead"] += dec * (1.0 - frac)
+            st.totals["finish_other"] += other
+            st.last = now
+
+    def on_preempt(self, seq) -> None:
+        """Evicted for KV pressure: the slice since the cursor is host
+        residue; the wait until re-admission becomes
+        ``preempted_requeue`` (marked at the next :meth:`on_admit`)."""
+        st = self._states.get(seq.request.rid)
+        if st is None:
+            return
+        now = time.monotonic()
+        self._mark(st, "finish_other", now)
+        st.requeued = True
+        st.last_token = None           # restart resets the TPOT baseline
+        st.phase = "queue"
+        self._trace_to(st, "req/preempted")
+
+    def on_finish(self, seq, step: int) -> Optional[Dict[str, Any]]:
+        """Close the ledger: final TPOT slice, tail mark, aggregate into
+        the cumulative gauges/counters, persist the JSONL record.
+        Returns the SLO dict the engine nests into ``results[rid]``."""
+        st = self._states.pop(seq.request.rid, None)
+        if st is None:
+            return None
+        req = seq.request
+        now = time.monotonic()
+        appended = self._observe_tpot(st, seq, now, step)
+        elapsed = now - st.last
+        if st.phase == "decode" and appended > 0:
+            # Finished mid-decode: the tail slice is that step's decode
+            # work for this row (bounded by one step).
+            frac = self._useful_frac(appended)
+            st.totals["decode_active"] += elapsed * frac
+            st.totals["spec_overhead"] += elapsed * (1.0 - frac)
+        else:
+            st.totals["finish_other"] += elapsed
+        st.last = now
+        lifetime = now - req.arrival
+        self._trace_to(st, None)
+
+        for c in REQUEST_CATEGORIES:
+            self._cat_totals[c] += st.totals[c]
+        self.completed += 1
+        reg = self.registry
+        if reg is not None:
+            reg.histogram("requests/e2e_ms").observe(lifetime * 1e3,
+                                                     step=step)
+            reg.histogram("requests/queue_wait_ms").observe(
+                st.totals["queue_wait"] * 1e3, step=step)
+            if req.preempted_count:
+                reg.counter("requests/preemptions").inc(
+                    req.preempted_count, step=step)
+            if st.prefix_tokens:
+                reg.counter("requests/prefix_tokens_saved").inc(
+                    st.prefix_tokens, step=step)
+
+        slo = {
+            "lifetime_sec": lifetime,
+            "tpot_mean_ms": (st.tpot_sum_ms / st.tpot_n
+                             if st.tpot_n else None),
+            "tpot_obs": st.tpot_n,
+            "prefix_tokens_saved": st.prefix_tokens,
+            "categories": {c: st.totals[c] for c in REQUEST_CATEGORIES},
+        }
+        rec = {
+            "format": RECORD_FORMAT,
+            "rid": req.rid,
+            "host": self.host,
+            "prompt_len": len(req.prompt),
+            "new_tokens": seq.generated,
+            "finish_step": step,
+            "arrival_unix": req.arrival + self._wall_offset,
+            "e2e_ms": lifetime * 1e3,
+            "ttft_ms": ((req.first_token_time - req.arrival) * 1e3
+                        if req.first_token_time is not None else None),
+            "queue_wait_ms": st.totals["queue_wait"] * 1e3,
+            "preempted_count": req.preempted_count,
+            **slo,
+        }
+        self._write(rec)
+        return slo
+
+    # -- engine serving-time partition ---------------------------------
+    def engine_mark(self, cat: str) -> None:
+        """Attribute the engine wall clock since the last mark to one
+        ``ENGINE_CATEGORIES`` bucket and advance the engine cursor."""
+        now = time.monotonic()
+        self._eng_totals[cat] += now - self._eng_last
+        self._eng_last = now
+
+    # -- rolling decode throughput -------------------------------------
+    def rolling_add(self, n_tokens: int, dt_decode: float) -> None:
+        now = time.monotonic()
+        self._window.append((now, int(n_tokens), float(dt_decode)))
+        cutoff = now - self.window_sec
+        while self._window and self._window[0][0] < cutoff:
+            self._window.popleft()
+
+    def rolling_rate(self) -> Optional[float]:
+        """Token-weighted decode tokens/s over the window (None before
+        any decode work lands in it)."""
+        cutoff = time.monotonic() - self.window_sec
+        while self._window and self._window[0][0] < cutoff:
+            self._window.popleft()
+        tok = sum(n for _, n, _ in self._window)
+        sec = sum(s for _, _, s in self._window)
+        return tok / sec if sec > 0 else None
+
+    # -- emission / persistence ----------------------------------------
+    def emit(self, step: int) -> None:
+        """Per-step gauges: cumulative per-category seconds over finished
+        requests plus the engine partition. Host floats only."""
+        reg = self.registry
+        if reg is None:
+            return
+        for c in REQUEST_CATEGORIES:
+            reg.gauge(f"requests/{c}_sec").set(self._cat_totals[c],
+                                               step=step)
+        for c in ENGINE_CATEGORIES:
+            reg.gauge(f"requests/engine_{c}_sec").set(
+                self._eng_totals[c], step=step)
+        reg.gauge("requests/engine_wall_sec").set(
+            time.monotonic() - self._eng_start, step=step)
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        if self.path is None or self._write_failed:
+            return
+        try:
+            if self._fh is None:
+                parent = os.path.dirname(self.path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                self._fh = open(self.path, "a", encoding="utf-8")
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        except OSError as e:  # noqa: BLE001 — records must never take
+            # down the serving loop they observe
+            self._write_failed = True
+            logger.warning("request records disabled (%s): %s",
+                           self.path, e)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+def build_requests(tcfg, telemetry=None) -> Optional[RequestAccountant]:
+    """Factory honoring the zero-overhead off-contract: returns ``None``
+    unless telemetry AND ``telemetry.requests`` are enabled, so every
+    engine hook stays a single ``is None`` check."""
+    if tcfg is None or not getattr(tcfg, "enabled", False):
+        return None
+    rcfg = getattr(tcfg, "requests", None)
+    if rcfg is None or not rcfg.enabled:
+        return None
+    return RequestAccountant(
+        registry=telemetry.registry if telemetry is not None else None,
+        tracer=telemetry.tracer if telemetry is not None else None,
+        run_dir=tcfg.dir,
+        file=rcfg.file,
+        window_sec=rcfg.window_sec)

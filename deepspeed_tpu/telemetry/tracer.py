@@ -177,6 +177,33 @@ class StepTracer:
                 "ts": self._us(time.perf_counter()),
                 "args": {"value": float(value)}})
 
+    def _async(self, ph: str, name: str, aid, cat: str,
+               args: Dict[str, Any]) -> None:
+        ev = {"name": name, "ph": ph, "cat": cat, "id": str(aid),
+              "pid": self._pid, "tid": threading.get_ident(),
+              "ts": self._us(time.perf_counter())}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._append(ev)
+
+    def async_begin(self, name: str, aid, cat: str = "request",
+                    **args) -> None:
+        """Open an async-track span (Chrome ``ph: b``): async events live
+        on their own (cat, id) track, so long-lived arcs — a serving
+        request's queue -> prefill -> decode lifecycle — render alongside
+        the step spans without nesting inside them. Pair with
+        :meth:`async_end` on the same (name, cat, id)."""
+        if not self.enabled:
+            return
+        self._async("b", name, aid, cat, args)
+
+    def async_end(self, name: str, aid, cat: str = "request",
+                  **args) -> None:
+        if not self.enabled:
+            return
+        self._async("e", name, aid, cat, args)
+
     @property
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -26,6 +26,11 @@ from deepspeed_tpu.telemetry.goodput import GOODPUT_METRIC_TAGS
 from deepspeed_tpu.telemetry.memory import MEMORY_METRIC_TAGS
 from deepspeed_tpu.telemetry.moe import MOE_METRIC_TAGS
 from deepspeed_tpu.telemetry.numerics import NUMERICS_METRIC_TAGS
+from deepspeed_tpu.telemetry.requests import (
+    ENGINE_CATEGORIES,
+    REQUEST_CATEGORIES,
+    REQUEST_METRIC_TAGS,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "deepspeed_tpu")
@@ -49,6 +54,10 @@ _AUTOTUNE_TOKEN_RE = re.compile(r"\bautotune/[A-Za-z_]+")
 # token followed by a dot/slash/word char (a file or module reference)
 # is not a metric tag.
 _MOE_TOKEN_RE = re.compile(r"\bmoe/[A-Za-z_]+(?![\w./])")
+# the doc writes the templated "requests/engine_<category>_sec" — the
+# (?![\w<]) lookahead (with backtracking blocked by \w) keeps the
+# "requests/engine_" prefix of that placeholder from scanning as a tag
+_REQUESTS_TOKEN_RE = re.compile(r"\brequests/[A-Za-z_]+(?![\w<])")
 
 
 def _iter_py_files():
@@ -374,6 +383,67 @@ class TestDocDrift:
         assert {"serving/decode_attn_kernel", "serving/prefix_hits",
                 "serving/prefix_blocks_reused", "serving/spec_accept_rate",
                 "serving/spec_tokens_per_verify"} <= SERVING_METRIC_TAGS
+
+    def test_request_tags_documented_and_vice_versa(self):
+        """The request-observatory surface (telemetry/requests.py) is
+        pinned in BOTH directions like goodput/fleet/serving: every tag
+        in REQUEST_METRIC_TAGS must be in the doc, and every requests/*
+        token the doc names must be one the accountant emits. The
+        per-category gauges are f-string emissions
+        (f"requests/{c}_sec"), so the literal-emission check covers the
+        non-f-string tags and the tag set itself covers the rest."""
+        doc = _doc_text()
+        undocumented = sorted(t for t in REQUEST_METRIC_TAGS
+                              if t not in doc)
+        assert not undocumented, undocumented
+        doc_tokens = set(_REQUESTS_TOKEN_RE.findall(doc))
+        assert doc_tokens, "the scan must see the documented request tags"
+        phantom = sorted(t for t in doc_tokens
+                         if t not in REQUEST_METRIC_TAGS)
+        assert not phantom, (
+            f"docs/OBSERVABILITY.md names request tags the code never "
+            f"emits: {phantom}")
+        # every literal (non-f-string) requests/* emission in the tree
+        # is a declared tag
+        emitted = {t for _, is_f, t in _emitted_literals()
+                   if not is_f and t.startswith("requests/")}
+        assert emitted, "the scan must see the request emissions"
+        assert emitted <= REQUEST_METRIC_TAGS, (
+            emitted - REQUEST_METRIC_TAGS)
+        # the derived per-category tags must map exactly onto the
+        # declared set — a renamed category would silently drop a gauge
+        derived = ({f"requests/{c}_sec" for c in REQUEST_CATEGORIES}
+                   | {f"requests/engine_{c}_sec"
+                      for c in ENGINE_CATEGORIES})
+        assert derived <= REQUEST_METRIC_TAGS, (
+            derived - REQUEST_METRIC_TAGS)
+        # the rolling-window companion gauge rides the serving
+        # enforcement
+        assert "serving/tokens_per_sec_window" in SERVING_METRIC_TAGS
+        assert "serving/tokens_per_sec_window" in doc
+
+    def test_slo_report_tags_in_sync(self):
+        """tools/slo_report.py is stdlib-only by design (no package
+        import), so its private tag/category copies are pinned here
+        instead — every requests/* literal the report reads must be one
+        the accountant emits, and its category tuples must mirror
+        telemetry/requests.py exactly."""
+        with open(os.path.join(REPO, "tools", "slo_report.py")) as f:
+            src = f.read()
+        report_tags = set(re.findall(r'"(requests/[A-Za-z_]+)"', src))
+        assert report_tags, "scan must see slo_report's tags"
+        # trailing-underscore literals are startswith() prefix probes
+        # (e.g. "requests/engine_"), not tags
+        phantom = sorted(t for t in report_tags
+                         if not t.endswith("_")
+                         and t not in REQUEST_METRIC_TAGS)
+        assert not phantom, (
+            f"tools/slo_report.py reads tags the code never emits: "
+            f"{phantom} — keep it in sync with telemetry/requests.py")
+        for cat in REQUEST_CATEGORIES + ENGINE_CATEGORIES:
+            assert f'"{cat}"' in src, (
+                f"tools/slo_report.py category tuples are missing "
+                f"{cat!r} — keep them in sync with telemetry/requests.py")
 
     def test_serving_report_tags_in_sync(self):
         """tools/serving_report.py is stdlib-only by design (no package

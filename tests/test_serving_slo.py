@@ -1,0 +1,407 @@
+"""Request observatory tests — per-request SLO accounting for serving.
+
+The acceptance gates of the request observatory (docs/OBSERVABILITY.md
+"Request observatory"):
+
+- **exact partition**: every finished request's six-category lifetime
+  partition sums to its measured lifetime — by construction, not within
+  a sampled tolerance;
+- a **preempted** request shows nonzero ``preempted_requeue``, resumes
+  WARM through the prefix cache, and its eviction count lands in the
+  record and the ``requests/preemptions`` counter;
+- the **zero-overhead off-contract**: with ``telemetry.requests`` off
+  the emitted tag set is byte-identical to the pre-observatory engine
+  and the device-sync count is unchanged (and the accountant itself
+  adds zero syncs even when on — host clocks only);
+- ``results[rid]`` carries ``finish_time`` / ``e2e_ms`` /
+  ``queue_wait_ms`` / ``preempted_count`` even with NO telemetry at all
+  (the always-on enrichment);
+- a **mixed trace** (preemption + prefix cache + speculative decode)
+  through ``run_until_complete`` produces host-scoped records whose
+  percentiles ``tools/slo_report.py`` reproduces from the files alone,
+  plus per-request async tracks in the Perfetto trace.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config.config import (ConfigError, ServingConfig,
+                                         TelemetryConfig,
+                                         TelemetryRequestsConfig)
+from deepspeed_tpu.models import make_gpt
+from deepspeed_tpu.serving import ServeEngine
+from deepspeed_tpu.serving.engine import SERVING_METRIC_TAGS
+from deepspeed_tpu.telemetry import (ENGINE_CATEGORIES, InMemorySink,
+                                     MetricsRegistry, RecompileDetector,
+                                     REQUEST_CATEGORIES, RequestAccountant,
+                                     StepTracer, Telemetry, build_requests)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The pre-observatory engine's emitted tag set on a simple trace (no
+# fast path, no preemption) — the off-contract pins this EXACTLY.
+BASELINE_SIMPLE_TAGS = {
+    "serving/ttft_ms", "serving/batch_occupancy",
+    "serving/kv_blocks_in_use", "serving/queue_depth",
+    "serving/tokens_per_sec", "serving/requests_completed",
+}
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    # fp32 like test_serving.py: argmax tie-flips are noise at bf16.
+    model, cfg = make_gpt("tiny", dropout_rate=0.0, max_seq_len=64,
+                          dtype=jnp.float32)
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)},
+                        {"input_ids": np.zeros((1, 8), np.int32)})["params"]
+    return model, cfg, params
+
+
+def _serve(model, params, telemetry=None, accountant=None, **overrides):
+    scfg = ServingConfig(**{
+        "max_batch_size": 2, "kv_block_size": 4, "kv_num_blocks": 64,
+        "max_model_len": 48, **overrides})
+    eng = deepspeed_tpu.init_inference(model, params=params,
+                                       dtype=jnp.float32)
+    return ServeEngine(eng, config=scfg, telemetry=telemetry,
+                       request_accountant=accountant)
+
+
+def _mem_telemetry():
+    reg = MetricsRegistry()
+    sink = reg.add_sink(InMemorySink())
+    tracer = StepTracer(path=None, enabled=False, sync_spans=False)
+    return Telemetry(reg, tracer, RecompileDetector(enabled=False)), sink
+
+
+def _mem_accountant(window_sec=10.0):
+    tel, sink = _mem_telemetry()
+    acc = RequestAccountant(registry=tel.registry, tracer=tel.tracer,
+                            window_sec=window_sec)
+    return tel, sink, acc
+
+
+def _drive(srv, cfg, n=3, seed=17):
+    rng = np.random.default_rng(seed)
+    rids = [srv.submit(rng.integers(0, cfg.vocab_size, (4 + i,)).tolist(),
+                       4 + i) for i in range(n)]
+    srv.run_until_complete()
+    return rids
+
+
+# ---------------------------------------------------------------------------
+# Exact partition
+# ---------------------------------------------------------------------------
+
+class TestExactPartition:
+    def test_categories_sum_to_lifetime(self, gpt_setup):
+        """The tentpole property: for EVERY finished request the six
+        categories sum to the measured lifetime — the mark cursor
+        attributes each slice exactly once, so nothing is dropped or
+        double-counted."""
+        model, cfg, params = gpt_setup
+        tel, sink, acc = _mem_accountant()
+        srv = _serve(model, params, telemetry=tel, accountant=acc)
+        rids = _drive(srv, cfg, n=3)
+        for rid in rids:
+            slo = srv.results[rid]["slo"]
+            parts = slo["categories"]
+            assert set(parts) == set(REQUEST_CATEGORIES)
+            assert sum(parts.values()) == pytest.approx(
+                slo["lifetime_sec"], abs=1e-6)
+            assert all(v >= 0.0 for v in parts.values()), parts
+            # a normal trace spends nothing preempted
+            assert parts["preempted_requeue"] == 0.0
+            assert parts["decode_active"] > 0.0
+        # the cumulative gauges equal the per-request sums
+        acc.emit(step=10_000)
+        for c in REQUEST_CATEGORIES:
+            want = sum(srv.results[r]["slo"]["categories"][c] for r in rids)
+            assert sink.values(f"requests/{c}_sec")[-1] == pytest.approx(
+                want, abs=1e-9)
+        # latency histograms observed once per request, TPOT per token
+        assert len(sink.values("requests/e2e_ms")) == len(rids)
+        assert len(sink.values("requests/queue_wait_ms")) == len(rids)
+        total_new = sum(srv.results[r]["slo"]["tpot_obs"] for r in rids)
+        assert len(sink.values("requests/tpot_ms")) == total_new > 0
+
+    def test_engine_partition_accounts_the_wall(self, gpt_setup):
+        """The engine-side cursor: the five serving-time categories sum
+        to (approximately) the engine wall clock, and a run that decodes
+        spends most marked time in decode+compile."""
+        model, cfg, params = gpt_setup
+        tel, sink, acc = _mem_accountant()
+        srv = _serve(model, params, telemetry=tel, accountant=acc)
+        _drive(srv, cfg, n=2)
+        acc.emit(step=10_000)
+        parts = {c: sink.values(f"requests/engine_{c}_sec")[-1]
+                 for c in ENGINE_CATEGORIES}
+        wall = sink.values("requests/engine_wall_sec")[-1]
+        # everything up to the last mark is attributed; only the tail
+        # between that mark and the emit is residue
+        assert sum(parts.values()) <= wall
+        assert sum(parts.values()) == pytest.approx(wall, abs=0.1)
+        assert parts["decode"] + parts["compile"] > 0.0
+        # rolling window gauge landed beside the cumulative one
+        assert sink.values("serving/tokens_per_sec_window")
+        assert sink.values("serving/tokens_per_sec_window")[-1] > 0
+
+
+# ---------------------------------------------------------------------------
+# Preemption
+# ---------------------------------------------------------------------------
+
+class TestPreemption:
+    def test_preempted_request_accounts_requeue_and_resumes_warm(
+            self, gpt_setup):
+        """Same KV-pressure scenario as test_serving.py's preemption
+        test, with the prefix cache on: the evicted (youngest) request
+        shows nonzero ``preempted_requeue`` in its partition, its
+        eviction lands in the record and counter, and its re-admission
+        adopts the cached prompt head (warm resume — nonzero
+        ``prefix_tokens_saved``)."""
+        model, cfg, params = gpt_setup
+        rng = np.random.default_rng(5)
+        tel, sink, acc = _mem_accountant()
+        srv = _serve(model, params, telemetry=tel, accountant=acc,
+                     kv_num_blocks=12, max_model_len=32, prefix_cache=True)
+        p0 = rng.integers(0, cfg.vocab_size, (7,)).tolist()
+        p1 = rng.integers(0, cfg.vocab_size, (6,)).tolist()
+        r0 = srv.submit(p0, 24)
+        r1 = srv.submit(p1, 20)
+        res = srv.run_until_complete()
+        assert srv.sched.preempted_total == 1
+        # the youngest (r1) was the victim
+        assert res[r1]["preempted_count"] == 1
+        assert res[r0]["preempted_count"] == 0
+        slo = res[r1]["slo"]
+        parts = slo["categories"]
+        assert parts["preempted_requeue"] > 0.0
+        assert sum(parts.values()) == pytest.approx(slo["lifetime_sec"],
+                                                    abs=1e-6)
+        assert res[r0]["slo"]["categories"]["preempted_requeue"] == 0.0
+        # warm resume: the first prefill registered r1's full prompt-head
+        # block, so the re-admission adopted it instead of re-prefilling
+        assert slo["prefix_tokens_saved"] >= 4
+        assert sink.values("requests/preemptions")[-1] == 1
+        assert sink.values("requests/prefix_tokens_saved")[-1] >= 4
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead off-contract
+# ---------------------------------------------------------------------------
+
+class TestOffContract:
+    def test_tag_set_unchanged_with_requests_off(self, gpt_setup,
+                                                 monkeypatch):
+        """With telemetry ON but no accountant, the emitted tag set is
+        byte-identical to the pre-observatory engine — no ``requests/*``
+        tags, no window gauge — and the loop performs zero device
+        syncs."""
+        model, cfg, params = gpt_setup
+        tel, sink = _mem_telemetry()
+        srv = _serve(model, params, telemetry=tel)
+        from deepspeed_tpu.utils import timer as timer_mod
+        calls = {"n": 0}
+        monkeypatch.setattr(timer_mod, "_device_synchronize",
+                            lambda: calls.__setitem__("n", calls["n"] + 1))
+        _drive(srv, cfg)
+        assert calls["n"] == 0
+        tags = {r["tag"] for r in sink.rows}
+        assert tags == BASELINE_SIMPLE_TAGS
+        assert not any(t.startswith("requests/") for t in tags)
+        assert "serving/tokens_per_sec_window" not in tags
+
+    def test_accountant_adds_zero_device_syncs(self, gpt_setup,
+                                               monkeypatch):
+        """The accountant is host ``time.monotonic`` arithmetic only:
+        turning it ON must not add a single device sync."""
+        model, cfg, params = gpt_setup
+        tel, sink, acc = _mem_accountant()
+        srv = _serve(model, params, telemetry=tel, accountant=acc)
+        from deepspeed_tpu.utils import timer as timer_mod
+        calls = {"n": 0}
+        monkeypatch.setattr(timer_mod, "_device_synchronize",
+                            lambda: calls.__setitem__("n", calls["n"] + 1))
+        _drive(srv, cfg)
+        assert calls["n"] == 0
+        tags = {r["tag"] for r in sink.rows}
+        # ... while the new surface IS present
+        assert BASELINE_SIMPLE_TAGS < tags
+        assert "serving/tokens_per_sec_window" in tags
+        new = tags - BASELINE_SIMPLE_TAGS - {"serving/tokens_per_sec_window"}
+        assert new and all(t.startswith("requests/") for t in new)
+
+
+# ---------------------------------------------------------------------------
+# results[rid] enrichment (always on, telemetry or not)
+# ---------------------------------------------------------------------------
+
+class TestResultsEnrichment:
+    def test_results_carry_slo_fields_without_telemetry(self, gpt_setup):
+        model, cfg, params = gpt_setup
+        srv = _serve(model, params)                   # no telemetry at all
+        rids = _drive(srv, cfg, n=2)
+        for rid in rids:
+            res = srv.results[rid]
+            assert isinstance(res["finish_time"], float)
+            assert res["e2e_ms"] > 0.0
+            assert res["queue_wait_ms"] is not None
+            assert res["queue_wait_ms"] >= 0.0
+            assert res["queue_wait_ms"] < res["e2e_ms"]
+            assert res["preempted_count"] == 0
+            assert "slo" not in res                   # accountant-only
+
+
+# ---------------------------------------------------------------------------
+# Mixed-trace e2e: records + slo_report + Perfetto tracks
+# ---------------------------------------------------------------------------
+
+class TestMixedTraceE2E:
+    def test_mixed_trace_reproduced_by_slo_report(self, gpt_setup,
+                                                  tmp_path):
+        """The acceptance gate: preemption + prefix cache + speculative
+        decode through ``init_serving``/``run_until_complete``; every
+        record's partition sums to its lifetime; ``slo_report --json``
+        reproduces the e2e percentiles from ``requests*.jsonl`` +
+        ``metrics*.jsonl`` alone; the trace holds per-request async
+        tracks."""
+        model, cfg, params = gpt_setup
+        srv = deepspeed_tpu.init_serving(
+            model, params=params, dtype=jnp.float32,
+            config={
+                "serving": {"max_batch_size": 2, "kv_block_size": 4,
+                            "kv_num_blocks": 12, "max_model_len": 32,
+                            "prefix_cache": True,
+                            "speculative": {"enabled": True, "k": 2}},
+                "telemetry": {"enabled": True, "dir": str(tmp_path),
+                              "trace": {"enabled": True},
+                              "requests": {"enabled": True,
+                                           "window_sec": 5.0}}})
+        rng = np.random.default_rng(5)
+        p0 = rng.integers(0, cfg.vocab_size, (7,)).tolist()
+        p1 = rng.integers(0, cfg.vocab_size, (6,)).tolist()
+        srv.submit(p0, 24)
+        srv.submit(p1, 20)
+        srv.run_until_complete()
+        assert srv.sched.preempted_total >= 1
+        srv.close()
+
+        rec_path = os.path.join(str(tmp_path), "requests.jsonl")
+        assert os.path.exists(rec_path)
+        with open(rec_path) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        assert len(records) == 2
+        for rec in records:
+            assert rec["format"] == 1
+            assert sum(rec["categories"].values()) == pytest.approx(
+                rec["lifetime_sec"], abs=1e-6)
+            assert rec["e2e_ms"] == pytest.approx(
+                rec["lifetime_sec"] * 1e3, abs=1e-3)
+            assert rec["ttft_ms"] is not None and rec["ttft_ms"] > 0
+        assert any(r["preempted_count"] >= 1 for r in records)
+        assert any(r["categories"]["preempted_requeue"] > 0
+                   for r in records)
+        # speculative decode ran: its overhead is attributed somewhere
+        assert any(r["categories"]["spec_overhead"] > 0 for r in records)
+
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "slo_report.py"),
+             str(tmp_path), "--json"], capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["n_requests"] == 2
+        e2es = sorted(r["e2e_ms"] for r in records)
+        assert report["e2e_ms"]["p50"] == pytest.approx(
+            (e2es[0] + e2es[1]) / 2, rel=1e-9)
+        assert report["tpot_source"] == "metrics"
+        assert report["tpot_ms"]["p50"] > 0
+        for c in REQUEST_CATEGORIES:
+            want = sum(r["categories"][c] for r in records)
+            assert report["category_sec"][c] == pytest.approx(want,
+                                                              abs=1e-9)
+        assert report["engine_partition_sec"]["decode"] > 0
+        assert report["preemptions"] >= 1
+        assert report["prefix_tokens_saved"] >= 4
+
+        # the human rendering works on the same dir
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "slo_report.py"),
+             str(tmp_path)], capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "time lost per category" in proc.stdout
+        assert "preemptions" in proc.stdout
+
+        # per-request async tracks in the Perfetto trace
+        with open(os.path.join(str(tmp_path), "trace.json")) as f:
+            events = json.load(f)["traceEvents"]
+        async_names = {e["name"] for e in events if e.get("ph") == "b"}
+        assert {"req/queue", "req/prefill", "req/decode",
+                "req/preempted"} <= async_names
+        assert any(e.get("ph") == "e" for e in events)
+
+        # the window gauge landed in the metrics JSONL
+        with open(os.path.join(str(tmp_path), "metrics.jsonl")) as f:
+            assert any('"serving/tokens_per_sec_window"' in line
+                       for line in f)
+
+        # serving_report picks up the record-sourced latency columns too
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "serving_report.py"),
+             str(tmp_path)], capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "request records" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Config + factory
+# ---------------------------------------------------------------------------
+
+class TestRequestsConfig:
+    def test_defaults_off(self):
+        tcfg = TelemetryConfig.from_dict(None)
+        assert tcfg.requests.enabled is False
+        assert build_requests(tcfg) is None
+
+    def test_enabled_telemetry_disabled_requests_is_none(self, tmp_path):
+        tcfg = TelemetryConfig.from_dict(
+            {"enabled": True, "dir": str(tmp_path)})
+        assert build_requests(tcfg) is None
+
+    def test_factory_builds_when_both_enabled(self, tmp_path):
+        tcfg = TelemetryConfig.from_dict(
+            {"enabled": True, "dir": str(tmp_path),
+             "requests": {"enabled": True, "window_sec": 3.0}})
+        acc = build_requests(tcfg)
+        assert isinstance(acc, RequestAccountant)
+        assert acc.window_sec == 3.0
+        assert acc.path == os.path.join(str(tmp_path), "requests.jsonl")
+
+    def test_rejects_bad_file_pattern(self):
+        with pytest.raises(ConfigError, match="requests"):
+            TelemetryRequestsConfig.from_dict({"file": "slo.jsonl"})
+        with pytest.raises(ConfigError, match="requests"):
+            TelemetryRequestsConfig.from_dict({"file": "requests.txt"})
+
+    def test_rejects_bad_window(self):
+        with pytest.raises(ConfigError, match="window_sec"):
+            TelemetryRequestsConfig.from_dict({"window_sec": 0})
+
+
+class TestSloReportCLI:
+    def test_selftest(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "slo_report.py"),
+             "--selftest"], capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+        assert "selftest ok" in proc.stdout

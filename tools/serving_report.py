@@ -39,6 +39,11 @@ import tempfile
 from typing import Any, Dict, List
 
 DEFAULT_METRICS_FILE = "metrics.jsonl"
+# Request-observatory records (telemetry/requests.py, one JSON object per
+# finished request, host-scoped like the metrics file) — the source of the
+# TTFT/TPOT/e2e percentile columns. tools/slo_report.py renders the full
+# per-request breakdown; here they ride next to the aggregate gauges.
+DEFAULT_REQUESTS_FILE = "requests.jsonl"
 
 HIST_TAGS = ("serving/ttft_ms",)
 GAUGE_TAGS = (
@@ -85,15 +90,60 @@ def _iter_rows(path: str):
                 yield row
 
 
+def _collect_request_latency(run_dir: str,
+                             requests_file: str) -> Dict[str, Any]:
+    """Percentile columns from the request observatory's records — every
+    ``requests*.jsonl`` in the run dir (multi-host runs host-scope the
+    name, same as the metrics file). Empty when the run had
+    ``telemetry.requests`` off."""
+    stem, ext = os.path.splitext(requests_file)
+    paths = sorted(glob.glob(os.path.join(run_dir, f"{stem}*{ext}")))
+    records: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue      # torn tail line of a live/killed run
+                if isinstance(row, dict) and "rid" in row \
+                        and "e2e_ms" in row:
+                    records.append(row)
+
+    def pcts(key):
+        vals = sorted(float(r[key]) for r in records
+                      if r.get(key) is not None)
+        if not vals:
+            return None
+        return {"p50": _percentile(vals, 50), "p90": _percentile(vals, 90),
+                "p99": _percentile(vals, 99)}
+
+    return {"files": [os.path.basename(p) for p in paths],
+            "n_requests": len(records),
+            "ttft_ms": pcts("ttft_ms"),
+            "tpot_ms": pcts("tpot_mean_ms"),
+            "e2e_ms": pcts("e2e_ms")}
+
+
 def collect(run_dir_or_file: str,
-            metrics_file: str = DEFAULT_METRICS_FILE) -> Dict[str, Any]:
+            metrics_file: str = DEFAULT_METRICS_FILE,
+            requests_file: str = DEFAULT_REQUESTS_FILE) -> Dict[str, Any]:
     """Aggregate serving/* rows from one metrics file or every
     ``metrics*.jsonl`` in a run dir (multi-host runs host-scope the
-    name)."""
+    name), plus request-record percentile columns when the run dir holds
+    ``requests*.jsonl``."""
+    request_latency = None
     if os.path.isdir(run_dir_or_file):
         stem, ext = os.path.splitext(metrics_file)
         paths = sorted(glob.glob(
             os.path.join(run_dir_or_file, f"{stem}*{ext}")))
+        request_latency = _collect_request_latency(run_dir_or_file,
+                                                   requests_file)
+        if not request_latency["n_requests"]:
+            request_latency = None
     else:
         paths = [run_dir_or_file]
     series: Dict[str, List[float]] = {}
@@ -165,6 +215,7 @@ def collect(run_dir_or_file: str,
     # both gauges are cumulative rates: the last value IS the run's
     report["spec_accept_rate"] = acc[-1] if acc else None
     report["spec_tokens_per_verify"] = tpv[-1] if tpv else None
+    report["request_latency"] = request_latency
     return report
 
 
@@ -204,6 +255,18 @@ def render(report: Dict[str, Any]) -> str:
         tpv = report.get("spec_tokens_per_verify") or 0
         out.append(f"  speculative     accept {acc:8.1%}   "
                    f"{tpv:.2f} tokens/verify")
+    rl = report.get("request_latency")
+    if rl:
+        out.append(f"  request records {rl['n_requests']} requests "
+                   f"({', '.join(rl['files'])}; full breakdown: "
+                   f"tools/slo_report.py)")
+        for label, key in (("rec TTFT", "ttft_ms"), ("rec TPOT", "tpot_ms"),
+                           ("rec e2e", "e2e_ms")):
+            p = rl.get(key)
+            if p:
+                out.append(f"  {label:<9}     p50 {p['p50']:9.1f} ms   "
+                           f"p90 {p['p90']:9.1f} ms   "
+                           f"p99 {p['p99']:9.1f} ms")
     out.append(f"  completed       {report['requests_completed']:.0f} "
                f"requests")
     if not report["n_rows"]:
@@ -298,7 +361,33 @@ def _selftest() -> int:
         assert "completed" in text
         assert "prefix reuse" in text and "speculative" in text
         assert "decode kernel" in text
+        # no request records yet -> no percentile columns
+        assert report["request_latency"] is None
         json.dumps(report)                         # serializable
+
+        # request-observatory records (host-scoped, torn tail tolerated)
+        # add the TTFT/TPOT/e2e percentile columns
+        with open(os.path.join(td, "requests.hostA.jsonl"), "w") as f:
+            for i in range(10):
+                f.write(json.dumps(
+                    {"rid": i, "e2e_ms": 100.0 + 10 * i,
+                     "ttft_ms": 10.0 + i,
+                     "tpot_mean_ms": 2.0 + 0.2 * i}) + "\n")
+            f.write('{"rid": 99, "torn')
+        with open(os.path.join(td, "requests.hostB.jsonl"), "w") as f:
+            f.write(json.dumps({"rid": 0, "e2e_ms": 500.0,
+                                "ttft_ms": None,
+                                "tpot_mean_ms": 4.0}) + "\n")
+        report = collect(td)
+        rl = report["request_latency"]
+        assert rl["n_requests"] == 11, rl
+        assert abs(rl["e2e_ms"]["p50"] - 150.0) < 1e-6, rl
+        assert rl["e2e_ms"]["p99"] > 190.0, rl
+        assert abs(rl["ttft_ms"]["p50"] - 14.5) < 1e-6, rl  # None skipped
+        assert abs(rl["tpot_ms"]["p50"] - 3.0) < 1e-6, rl
+        text = render(report)
+        assert "rec TPOT" in text and "rec e2e" in text
+        json.dumps(report)
     print("\nselftest ok")
     return 0
 
@@ -309,6 +398,7 @@ def main(argv=None) -> int:
                     help="the job's telemetry.dir (or a metrics JSONL "
                          "file)")
     ap.add_argument("--metrics-file", default=DEFAULT_METRICS_FILE)
+    ap.add_argument("--requests-file", default=DEFAULT_REQUESTS_FILE)
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report")
     ap.add_argument("--selftest", action="store_true",
@@ -318,7 +408,8 @@ def main(argv=None) -> int:
         return _selftest()
     if not args.run_dir:
         ap.error("run dir required (or --selftest)")
-    report = collect(args.run_dir, metrics_file=args.metrics_file)
+    report = collect(args.run_dir, metrics_file=args.metrics_file,
+                     requests_file=args.requests_file)
     if args.json:
         print(json.dumps(report, indent=1, sort_keys=True))
     else:

@@ -1,0 +1,340 @@
+#!/usr/bin/env python
+"""Render per-request SLO evidence from the request observatory's files.
+
+The request-level companion of serving_report: where serving_report reads
+the engine's aggregate ``serving/*`` gauges, this merges the PER-REQUEST
+records (``requests*.jsonl`` — one JSON object per finished request,
+host-scoped like ``metrics.<host>.jsonl``) with the ``requests/*`` metric
+rows from every host/replica in a run dir and renders
+
+- **latency percentiles**: TTFT / TPOT (inter-token) / e2e / queue wait,
+  p50/p90/p99 tables — the SLO surface the scale-out router ranks
+  replicas with;
+- **time lost per category**: the exact lifetime partition summed over
+  requests (queue_wait / prefill / decode_active / preempted_requeue /
+  spec_overhead / finish_other), seconds + share — "where did the fleet's
+  request-seconds go";
+- **engine serving-time partition**: what fraction of each engine's wall
+  clock produced tokens (prefill / decode / scheduler+admission /
+  host_idle / compile), summed across host files;
+- **prefix-cache savings attribution** (tokens the warm heads skipped)
+  and preemption counts.
+
+    python tools/slo_report.py /runs/serve17/telemetry
+    python tools/slo_report.py /runs/serve17/telemetry --json
+    python tools/slo_report.py --selftest
+
+Standalone on purpose: stdlib only, so it runs anywhere the run dir
+lands. Keep the tag strings in sync with
+deepspeed_tpu/telemetry/requests.py REQUEST_METRIC_TAGS —
+tests/test_doc_lint.py pins them.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+DEFAULT_REQUESTS_FILE = "requests.jsonl"
+DEFAULT_METRICS_FILE = "metrics.jsonl"
+
+# Mirrors telemetry/requests.py REQUEST_CATEGORIES / ENGINE_CATEGORIES
+# (stdlib-only tool: no package import; the doc-lint sync test pins the
+# metric tags below against REQUEST_METRIC_TAGS).
+CATEGORIES = ("queue_wait", "prefill", "decode_active",
+              "preempted_requeue", "spec_overhead", "finish_other")
+ENGINE_CATEGORIES = ("prefill", "decode", "scheduler_admission",
+                     "host_idle", "compile")
+
+TPOT_TAG = "requests/tpot_ms"
+ENGINE_WALL_TAG = "requests/engine_wall_sec"
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Linear-interpolated percentile over an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo)
+
+
+def _pcts(vals: List[float]) -> Optional[Dict[str, float]]:
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    return {"p50": _percentile(vals, 50), "p90": _percentile(vals, 90),
+            "p99": _percentile(vals, 99), "n": len(vals)}
+
+
+def _iter_json_lines(path: str):
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue          # torn tail line of a live/killed run
+            if isinstance(row, dict):
+                yield row
+
+
+def _glob(run_dir: str, filename: str) -> List[str]:
+    stem, ext = os.path.splitext(filename)
+    return sorted(glob.glob(os.path.join(run_dir, f"{stem}*{ext}")))
+
+
+def collect(run_dir: str,
+            requests_file: str = DEFAULT_REQUESTS_FILE,
+            metrics_file: str = DEFAULT_METRICS_FILE) -> Dict[str, Any]:
+    """Merge ``requests*.jsonl`` records + ``requests/*`` metric rows
+    from every host-scoped file in the run dir."""
+    rec_paths = _glob(run_dir, requests_file)
+    records: List[Dict[str, Any]] = []
+    for path in rec_paths:
+        for row in _iter_json_lines(path):
+            if "rid" in row and "e2e_ms" in row:
+                records.append(row)
+
+    # requests/tpot_ms histogram rows carry EVERY inter-token interval —
+    # the true TPOT distribution (per-record tpot_mean_ms is the
+    # fallback when only records landed). The engine-partition gauges
+    # are cumulative: last value per host file, hosts sum.
+    tpot_obs: List[float] = []
+    engine_part: Dict[str, float] = {}
+    engine_wall = 0.0
+    met_paths = _glob(run_dir, metrics_file)
+    for path in met_paths:
+        last: Dict[str, float] = {}
+        for row in _iter_json_lines(path):
+            tag = row.get("tag")
+            if not isinstance(tag, str) or not tag.startswith("requests/"):
+                continue
+            val = float(row.get("value", 0.0))
+            if tag == TPOT_TAG:
+                tpot_obs.append(val)
+            elif tag.startswith("requests/engine_"):
+                last[tag] = val
+        for c in ENGINE_CATEGORIES:
+            tag = f"requests/engine_{c}_sec"
+            if tag in last:
+                engine_part[c] = engine_part.get(c, 0.0) + last[tag]
+        if ENGINE_WALL_TAG in last:
+            engine_wall += last[ENGINE_WALL_TAG]
+
+    report: Dict[str, Any] = {
+        "record_files": [os.path.basename(p) for p in rec_paths],
+        "metric_files": [os.path.basename(p) for p in met_paths],
+        "n_requests": len(records),
+        "hosts": sorted({r.get("host") for r in records
+                         if r.get("host") is not None}),
+    }
+    report["ttft_ms"] = _pcts([r.get("ttft_ms") for r in records])
+    report["tpot_ms"] = (_pcts(tpot_obs) if tpot_obs
+                         else _pcts([r.get("tpot_mean_ms")
+                                     for r in records]))
+    report["tpot_source"] = ("metrics" if tpot_obs
+                             else "records" if records else None)
+    report["e2e_ms"] = _pcts([r.get("e2e_ms") for r in records])
+    report["queue_wait_ms"] = _pcts([r.get("queue_wait_ms")
+                                     for r in records])
+
+    # -- time lost per category (exact partition, summed) ---------------
+    cat_sec = {c: 0.0 for c in CATEGORIES}
+    for r in records:
+        cats = r.get("categories") or {}
+        for c in CATEGORIES:
+            cat_sec[c] += float(cats.get(c, 0.0))
+    total_sec = sum(cat_sec.values())
+    report["category_sec"] = cat_sec
+    report["category_frac"] = (
+        {c: cat_sec[c] / total_sec for c in CATEGORIES}
+        if total_sec > 0 else None)
+    report["total_request_sec"] = total_sec
+
+    # -- engine serving-time partition -----------------------------------
+    report["engine_partition_sec"] = engine_part or None
+    report["engine_wall_sec"] = engine_wall or None
+    report["engine_decode_frac"] = (
+        engine_part.get("decode", 0.0) / engine_wall
+        if engine_part and engine_wall else None)
+
+    # -- prefix-cache savings + preemption -------------------------------
+    report["prefix_tokens_saved"] = sum(
+        int(r.get("prefix_tokens_saved") or 0) for r in records)
+    report["requests_with_prefix_hit"] = sum(
+        1 for r in records if (r.get("prefix_tokens_saved") or 0) > 0)
+    report["preemptions"] = sum(
+        int(r.get("preempted_count") or 0) for r in records)
+    report["requests_preempted"] = sum(
+        1 for r in records if (r.get("preempted_count") or 0) > 0)
+    return report
+
+
+def render(report: Dict[str, Any]) -> str:
+    out = ["request SLO report"]
+    out.append(f"  records: {', '.join(report['record_files']) or '<none>'}"
+               f"  ({report['n_requests']} requests"
+               + (f", hosts {', '.join(report['hosts'])}"
+                  if report["hosts"] else "") + ")")
+    for label, key in (("TTFT", "ttft_ms"), ("TPOT", "tpot_ms"),
+                       ("e2e", "e2e_ms"), ("queue wait", "queue_wait_ms")):
+        p = report.get(key)
+        if p:
+            src = (f"  [{report['tpot_source']}, {p['n']} obs]"
+                   if key == "tpot_ms" else "")
+            out.append(f"  {label:<11} p50 {p['p50']:9.1f} ms   "
+                       f"p90 {p['p90']:9.1f} ms   "
+                       f"p99 {p['p99']:9.1f} ms{src}")
+    if report["total_request_sec"] > 0:
+        out.append(f"  time lost per category "
+                   f"({report['total_request_sec']:.2f} request-seconds "
+                   f"total):")
+        frac = report["category_frac"]
+        for c in CATEGORIES:
+            out.append(f"    {c:<18} {report['category_sec'][c]:10.3f} s  "
+                       f"{frac[c]:7.1%}")
+    ep = report.get("engine_partition_sec")
+    if ep:
+        wall = report.get("engine_wall_sec") or 0.0
+        out.append(f"  engine serving-time partition "
+                   f"({wall:.2f} s wall):")
+        for c in ENGINE_CATEGORIES:
+            sec = ep.get(c, 0.0)
+            share = f"{sec / wall:7.1%}" if wall else "      -"
+            out.append(f"    {c:<18} {sec:10.3f} s  {share}")
+    if report["requests_with_prefix_hit"]:
+        out.append(f"  prefix cache    {report['prefix_tokens_saved']} "
+                   f"prompt tokens skipped across "
+                   f"{report['requests_with_prefix_hit']} warm requests")
+    if report["preemptions"]:
+        out.append(f"  preemptions     {report['preemptions']} across "
+                   f"{report['requests_preempted']} requests")
+    if not report["n_requests"]:
+        out.append("  (no request records found — was the engine run with "
+                   "telemetry.requests enabled?)")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Selftest
+# ---------------------------------------------------------------------------
+
+def _selftest() -> int:
+    """Synthesize host-scoped request records (+ a torn tail) and a
+    metrics file, then assert the merged percentiles, the category
+    table and the savings attribution."""
+    def rec(rid, host, e2e, ttft, tpot, qw, cats, prefix=0, preempted=0):
+        return {"format": 1, "rid": rid, "host": host, "prompt_len": 8,
+                "new_tokens": 4, "finish_step": rid, "e2e_ms": e2e,
+                "ttft_ms": ttft, "tpot_mean_ms": tpot, "queue_wait_ms": qw,
+                "prefix_tokens_saved": prefix, "preempted_count": preempted,
+                "lifetime_sec": e2e / 1e3, "categories": cats}
+
+    def cats(**kw):
+        d = {c: 0.0 for c in CATEGORIES}
+        d.update(kw)
+        return d
+
+    with tempfile.TemporaryDirectory() as td:
+        recs_a = [rec(i, "hostA", e2e=100.0 + 10 * i, ttft=10.0 + i,
+                      tpot=2.0 + 0.1 * i, qw=5.0,
+                      cats=cats(queue_wait=0.005, prefill=0.01,
+                                decode_active=0.08))
+                  for i in range(10)]
+        with open(os.path.join(td, "requests.hostA.jsonl"), "w") as f:
+            for r in recs_a:
+                f.write(json.dumps(r) + "\n")
+            f.write('{"rid": 99, "torn')            # must be tolerated
+        with open(os.path.join(td, "requests.hostB.jsonl"), "w") as f:
+            f.write(json.dumps(rec(
+                0, "hostB", e2e=500.0, ttft=50.0, tpot=4.0, qw=200.0,
+                cats=cats(queue_wait=0.2, prefill=0.05, decode_active=0.2,
+                          preempted_requeue=0.05),
+                prefix=16, preempted=1)) + "\n")
+        with open(os.path.join(td, "metrics.hostA.jsonl"), "w") as f:
+            for i, v in enumerate((1.0, 2.0, 3.0, 4.0)):
+                f.write(json.dumps(
+                    {"tag": "requests/tpot_ms", "value": v, "step": i,
+                     "kind": "histogram"}) + "\n")
+            for tag, v in (("requests/engine_prefill_sec", 0.5),
+                           ("requests/engine_decode_sec", 2.0),
+                           ("requests/engine_scheduler_admission_sec", 0.1),
+                           ("requests/engine_host_idle_sec", 0.3),
+                           ("requests/engine_compile_sec", 1.0),
+                           ("requests/engine_wall_sec", 4.0)):
+                f.write(json.dumps({"tag": tag, "value": v, "step": 9,
+                                    "kind": "gauge"}) + "\n")
+
+        report = collect(td)
+        assert report["n_requests"] == 11, report
+        assert report["hosts"] == ["hostA", "hostB"], report
+        # e2e over 100..190 + 500: p50 is the 6th of 11 sorted values
+        assert abs(report["e2e_ms"]["p50"] - 150.0) < 1e-6, report
+        assert report["e2e_ms"]["p99"] > 190.0, report
+        assert abs(report["ttft_ms"]["p50"] - 15.0) < 1e-6, report
+        # TPOT prefers the metric observations (1, 2, 3, 4 -> p50 2.5)
+        assert report["tpot_source"] == "metrics", report
+        assert abs(report["tpot_ms"]["p50"] - 2.5) < 1e-6, report
+        # category table sums across hosts
+        assert abs(report["category_sec"]["decode_active"]
+                   - (0.08 * 10 + 0.2)) < 1e-9, report
+        assert abs(report["category_sec"]["preempted_requeue"]
+                   - 0.05) < 1e-9, report
+        assert report["category_frac"]["decode_active"] > 0.5, report
+        # engine partition: last gauge value per file
+        assert report["engine_partition_sec"]["decode"] == 2.0, report
+        assert abs(report["engine_decode_frac"] - 0.5) < 1e-6, report
+        assert report["prefix_tokens_saved"] == 16, report
+        assert report["requests_with_prefix_hit"] == 1, report
+        assert report["preemptions"] == 1, report
+        text = render(report)
+        assert "TPOT" in text and "time lost" in text
+        assert "prefix cache" in text and "preemptions" in text
+        assert "engine serving-time partition" in text
+        json.dumps(report)                          # serializable
+
+        # TPOT falls back to per-record means without metric rows
+        os.remove(os.path.join(td, "metrics.hostA.jsonl"))
+        report = collect(td)
+        assert report["tpot_source"] == "records", report
+        assert report["tpot_ms"]["n"] == 11, report
+    print("\nselftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", nargs="?",
+                    help="the job's telemetry.dir (holds requests*.jsonl "
+                         "+ metrics*.jsonl)")
+    ap.add_argument("--requests-file", default=DEFAULT_REQUESTS_FILE)
+    ap.add_argument("--metrics-file", default=DEFAULT_METRICS_FILE)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in round-trip check and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.run_dir:
+        ap.error("run dir required (or --selftest)")
+    report = collect(args.run_dir, requests_file=args.requests_file,
+                     metrics_file=args.metrics_file)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
