@@ -286,6 +286,15 @@ SERVING_REQUESTS_CFG = {
     "window_sec": 10.0,
 }
 
+# Resilience config for the serving overload A/B row
+# (serving/resilience.py; docs/SERVING.md "Serving under failure").
+# Depth-bounded shedding only: deterministic on a cold engine, so the
+# A/B row is reproducible. Recorded in the environment block.
+SERVING_RESILIENCE_CFG = {
+    "enabled": True,
+    "max_queue_depth": 6,
+}
+
 
 def bench_serving(n_requests=12):
     """Offline serving throughput + latency SLOs through the
@@ -472,6 +481,70 @@ def bench_serving_fastpath():
     rows["spec_tokens_per_step"] = round(
         st["spec_new_tokens"] / max(1, st["spec_rounds"]), 3)
     return rows
+
+
+def bench_serving_overload(n_requests=24):
+    """Serving overload A/B (docs/SERVING.md "Serving under failure"):
+    the same burst trace — offered load well past the 4-slot engine's
+    capacity — with shedding off (everything queues; tail TTFT collapses
+    under queue wait) vs the admission controller on per
+    SERVING_RESILIENCE_CFG (overflow sheds at submit; admitted requests
+    keep their TTFT). Returns shed fraction + admitted TTFT p99 rows for
+    both arms."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import make_gpt
+
+    model, cfg = make_gpt("tiny", dropout_rate=0.0, max_seq_len=128,
+                          dtype=jnp.float32)
+    rng = np.random.default_rng(3)
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)},
+                        {"input_ids": np.zeros((1, 8), np.int32)})["params"]
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (int(rng.integers(6, 48)),)).tolist()
+               for _ in range(n_requests)]
+    outs = [int(rng.integers(16, 40)) for _ in range(n_requests)]
+
+    def run(resilient):
+        scfg = dict(SERVING_BENCH_CFG)
+        if resilient:
+            scfg["resilience"] = dict(SERVING_RESILIENCE_CFG)
+        srv = deepspeed_tpu.init_serving(
+            model, params=params, dtype=jnp.float32,
+            config={"serving": scfg,
+                    "telemetry": {"enabled": True, "dir": ".",
+                                  "metrics": {"sinks": ["memory"]},
+                                  "trace": {"enabled": False}}})
+        # warmup: compile every prefill bucket + decode off the clock
+        seen = set()
+        for p in prompts:
+            b = srv._bucket_of(len(p))
+            if b not in seen:
+                seen.add(b)
+                srv.submit(p, 2)
+        srv.run_until_complete()
+        srv.results.clear()
+        hist = srv.telemetry.registry.histogram("serving/ttft_ms")
+        hist.reset()
+        for p, n in zip(prompts, outs):      # the burst: all at once
+            srv.submit(p, n)
+        res = srv.run_until_complete()
+        shed = sum(1 for r in res.values() if r.get("status") == "shed")
+        ttft_p99 = hist.percentile(99)        # admitted requests only:
+        srv.close()                           # shed rows never observe
+        return shed / len(res), ttft_p99
+
+    shed_off, ttft_off = run(resilient=False)
+    shed_on, ttft_on = run(resilient=True)
+    assert shed_off == 0.0, "shedding happened with resilience off"
+    return {
+        "overload_shed_frac_off": round(shed_off, 4),
+        "overload_shed_frac_on": round(shed_on, 4),
+        "overload_admitted_ttft_p99_off_ms": round(ttft_off, 2),
+        "overload_admitted_ttft_p99_on_ms": round(ttft_on, 2),
+    }
 
 
 def _section_rows(result, name, **rows):
@@ -683,6 +756,9 @@ def main():
         # Request observatory (telemetry/requests.py) behind the serving
         # section's tpot_p50_ms/tpot_p99_ms/e2e_p99_ms rows.
         "requests": dict(SERVING_REQUESTS_CFG),
+        # Serving resilience (serving/resilience.py) behind the overload
+        # A/B rows; every other serving row runs with resilience off.
+        "serving_resilience": dict(SERVING_RESILIENCE_CFG),
     }
 
     if on_tpu:
@@ -833,6 +909,18 @@ def main():
             f"({time.time() - t0:.0f}s)")
         for key, val in fp.items():
             result[f"serving_{key}"] = val
+        # overload A/B (docs/SERVING.md "Serving under failure"):
+        # offered load > capacity, shedding off vs on.
+        t0 = time.time()
+        ov = bench_serving_overload()
+        log(f"[bench] serving overload: shed "
+            f"{ov['overload_shed_frac_off']:.0%} off vs "
+            f"{ov['overload_shed_frac_on']:.0%} on; admitted TTFT p99 "
+            f"{ov['overload_admitted_ttft_p99_off_ms']:.1f} ms off vs "
+            f"{ov['overload_admitted_ttft_p99_on_ms']:.1f} ms on "
+            f"({time.time() - t0:.0f}s)")
+        for key, val in ov.items():
+            result[f"serving_{key}"] = val
         # tpot/e2e rows are `*_ms`, so bench_gate treats them as
         # lower-is-better automatically (latency regresses upward).
         _section_rows(result, "serving",
@@ -843,7 +931,7 @@ def main():
                       tpot_p99_ms=result["serving_tpot_p99_ms"],
                       e2e_p99_ms=result["serving_e2e_p99_ms"],
                       mean_occupancy=result["serving_mean_occupancy"],
-                      **fp)
+                      **fp, **ov)
 
     def gpt_ab_times(gas, make_config):
         # Shared 2-slice tiny-GPT A/B harness for the comm_overlap and

@@ -369,6 +369,14 @@ def init_serving(model=None, config=None, **kwargs):
     tcfg = TelemetryConfig.from_dict(config.get("telemetry"))
     tel = build_telemetry(tcfg)
     engine = init_inference(model, tracer=tel.tracer, **kwargs)
+    # Serving chaos rides the SAME resilience.fault_injection block (and
+    # DSTPU_FAULT_PLAN env override) as the training loop — the serve_*
+    # FaultPlan fields drive serving/resilience.py's recovery paths.
+    fault_plan = None
+    rblock = dict(config.get("resilience") or {})
+    if rblock.get("fault_injection"):
+        from deepspeed_tpu.resilience import FaultPlan
+        fault_plan = FaultPlan.resolve(rblock["fault_injection"])
     # telemetry.numerics opt-in gates the per-prefill int8 KV-cache
     # round-trip-error gauge (docs/OBSERVABILITY.md "Numerics
     # observatory"); telemetry.requests gates the per-request SLO
@@ -376,7 +384,8 @@ def init_serving(model=None, config=None, **kwargs):
     # telemetry-only deployments pay nothing extra for either.
     return ServeEngine(engine, config=scfg, telemetry=tel,
                        measure_kv_quant_error=tcfg.numerics.enabled,
-                       request_accountant=build_requests(tcfg, tel))
+                       request_accountant=build_requests(tcfg, tel),
+                       fault_plan=fault_plan)
 
 
 __all__ = [

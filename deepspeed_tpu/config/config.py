@@ -1006,6 +1006,15 @@ class ServingConfig:
     ``prefix_cache`` turns on COW prompt-head block reuse;
     ``speculative`` configures draft-model speculative decoding
     (greedy-identical by construction — requires ``temperature == 0``).
+
+    Resilience (docs/SERVING.md "Serving under failure" — off by
+    default, zero-overhead): the ``resilience`` sub-block turns on
+    per-request deadlines + ``cancel()``, the SLO-aware admission gate
+    (``max_queue_wait_ms`` projected-wait shed, ``max_queue_depth``
+    hard backstop), decode-dispatch retry/rebuild/replay recovery
+    (``max_retries`` / ``retry_base_sec``) and the degradation ladder
+    (``degrade_after`` anomalies per rung; ``slow_step_ms`` marks a
+    decode step as an anomaly).
     """
 
     max_batch_size: int = C.SERVING_MAX_BATCH_SIZE_DEFAULT
@@ -1023,6 +1032,14 @@ class ServingConfig:
     spec_decode: bool = C.SERVING_SPEC_ENABLED_DEFAULT
     spec_k: int = C.SERVING_SPEC_K_DEFAULT
     spec_draft_layers: Optional[int] = None
+    resilience: bool = C.SERVING_RESIL_ENABLED_DEFAULT
+    resil_max_queue_depth: Optional[int] = None
+    resil_max_queue_wait_ms: Optional[float] = None
+    resil_default_deadline_ms: Optional[float] = None
+    resil_max_retries: int = C.SERVING_RESIL_MAX_RETRIES_DEFAULT
+    resil_retry_base_sec: float = C.SERVING_RESIL_RETRY_BASE_SEC_DEFAULT
+    resil_degrade_after: int = C.SERVING_RESIL_DEGRADE_AFTER_DEFAULT
+    resil_slow_step_ms: Optional[float] = None
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -1065,6 +1082,52 @@ class ServingConfig:
         cfg.spec_draft_layers = (
             int(spec[C.SERVING_SPEC_DRAFT_LAYERS])
             if spec.get(C.SERVING_SPEC_DRAFT_LAYERS) is not None else None)
+        resil = d.get(C.SERVING_RESILIENCE) or {}
+        if not isinstance(resil, dict):
+            raise ConfigError("serving.resilience must be a dict")
+        # a present block defaults to enabled (like `moe`): writing
+        # `resilience: {}` is an opt-in, `enabled: false` keeps it inert
+        cfg.resilience = bool(resil.get(C.SERVING_RESIL_ENABLED,
+                                        bool(resil) or
+                                        C.SERVING_RESIL_ENABLED_DEFAULT))
+        cfg.resil_max_queue_depth = (
+            int(resil[C.SERVING_RESIL_MAX_QUEUE_DEPTH])
+            if resil.get(C.SERVING_RESIL_MAX_QUEUE_DEPTH) is not None
+            else None)
+        cfg.resil_max_queue_wait_ms = (
+            float(resil[C.SERVING_RESIL_MAX_QUEUE_WAIT_MS])
+            if resil.get(C.SERVING_RESIL_MAX_QUEUE_WAIT_MS) is not None
+            else None)
+        cfg.resil_default_deadline_ms = (
+            float(resil[C.SERVING_RESIL_DEFAULT_DEADLINE_MS])
+            if resil.get(C.SERVING_RESIL_DEFAULT_DEADLINE_MS) is not None
+            else None)
+        cfg.resil_max_retries = int(resil.get(
+            C.SERVING_RESIL_MAX_RETRIES,
+            C.SERVING_RESIL_MAX_RETRIES_DEFAULT))
+        cfg.resil_retry_base_sec = float(resil.get(
+            C.SERVING_RESIL_RETRY_BASE_SEC,
+            C.SERVING_RESIL_RETRY_BASE_SEC_DEFAULT))
+        cfg.resil_degrade_after = int(resil.get(
+            C.SERVING_RESIL_DEGRADE_AFTER,
+            C.SERVING_RESIL_DEGRADE_AFTER_DEFAULT))
+        cfg.resil_slow_step_ms = (
+            float(resil[C.SERVING_RESIL_SLOW_STEP_MS])
+            if resil.get(C.SERVING_RESIL_SLOW_STEP_MS) is not None
+            else None)
+        known_resil = {C.SERVING_RESIL_ENABLED,
+                       C.SERVING_RESIL_MAX_QUEUE_DEPTH,
+                       C.SERVING_RESIL_MAX_QUEUE_WAIT_MS,
+                       C.SERVING_RESIL_DEFAULT_DEADLINE_MS,
+                       C.SERVING_RESIL_MAX_RETRIES,
+                       C.SERVING_RESIL_RETRY_BASE_SEC,
+                       C.SERVING_RESIL_DEGRADE_AFTER,
+                       C.SERVING_RESIL_SLOW_STEP_MS}
+        unknown = set(resil) - known_resil
+        if unknown:
+            raise ConfigError(
+                f"unknown serving.resilience keys {sorted(unknown)}; "
+                f"expected a subset of {sorted(known_resil)}")
         if cfg.max_batch_size < 1:
             raise ConfigError("serving.max_batch_size must be >= 1")
         if cfg.kv_block_size < 1:
@@ -1096,6 +1159,29 @@ class ServingConfig:
                 "serving.speculative requires temperature == 0 (greedy): "
                 "the accept/rollback contract is token-identity with "
                 "greedy decode")
+        if cfg.resil_max_queue_depth is not None \
+                and cfg.resil_max_queue_depth < 1:
+            raise ConfigError(
+                "serving.resilience.max_queue_depth must be >= 1")
+        if cfg.resil_max_queue_wait_ms is not None \
+                and cfg.resil_max_queue_wait_ms <= 0:
+            raise ConfigError(
+                "serving.resilience.max_queue_wait_ms must be > 0")
+        if cfg.resil_default_deadline_ms is not None \
+                and cfg.resil_default_deadline_ms <= 0:
+            raise ConfigError(
+                "serving.resilience.default_deadline_ms must be > 0")
+        if cfg.resil_max_retries < 0:
+            raise ConfigError("serving.resilience.max_retries must be >= 0")
+        if cfg.resil_retry_base_sec <= 0:
+            raise ConfigError(
+                "serving.resilience.retry_base_sec must be > 0")
+        if cfg.resil_degrade_after < 1:
+            raise ConfigError(
+                "serving.resilience.degrade_after must be >= 1")
+        if cfg.resil_slow_step_ms is not None and cfg.resil_slow_step_ms <= 0:
+            raise ConfigError(
+                "serving.resilience.slow_step_ms must be > 0")
         return cfg
 
 

@@ -313,6 +313,23 @@ SERVING_SPEC_ENABLED_DEFAULT = False
 SERVING_SPEC_K = "k"                      # draft tokens proposed per round
 SERVING_SPEC_K_DEFAULT = 4
 SERVING_SPEC_DRAFT_LAYERS = "draft_layers"  # None -> num_layers // 2
+# serving resilience sub-block (serving/resilience.py; docs/SERVING.md
+# "Serving under failure"): deadlines + cancellation, SLO-aware load
+# shedding, in-flight recovery + degradation ladder — off by default
+# under the established zero-overhead contract.
+SERVING_RESILIENCE = "resilience"
+SERVING_RESIL_ENABLED = "enabled"
+SERVING_RESIL_ENABLED_DEFAULT = False
+SERVING_RESIL_MAX_QUEUE_DEPTH = "max_queue_depth"      # None -> unbounded
+SERVING_RESIL_MAX_QUEUE_WAIT_MS = "max_queue_wait_ms"  # None -> no wait gate
+SERVING_RESIL_DEFAULT_DEADLINE_MS = "default_deadline_ms"  # None -> none
+SERVING_RESIL_MAX_RETRIES = "max_retries"  # decode-dispatch retries
+SERVING_RESIL_MAX_RETRIES_DEFAULT = 2
+SERVING_RESIL_RETRY_BASE_SEC = "retry_base_sec"
+SERVING_RESIL_RETRY_BASE_SEC_DEFAULT = 0.05
+SERVING_RESIL_DEGRADE_AFTER = "degrade_after"  # anomalies per ladder rung
+SERVING_RESIL_DEGRADE_AFTER_DEFAULT = 2
+SERVING_RESIL_SLOW_STEP_MS = "slow_step_ms"  # None -> no slow-step anomaly
 
 #############################################
 # Logging / misc

@@ -33,7 +33,23 @@ faults a run must survive:
   the highest surviving index);
 - ``rejoin_after_steps`` — the preempted slice "returns" this many step
   attempts after the shrink, exercising the step-boundary rejoin path
-  deterministically.
+  deterministically;
+- ``serve_decode_fault_at_step`` / ``serve_decode_fault_count`` — the
+  SERVING chaos events (serving/resilience.py; docs/SERVING.md "Serving
+  under failure"): the decode/spec dispatch raises ``RuntimeError`` for
+  a window of decode **dispatch attempts** (a monotonic count the
+  engine keeps — retries advance it, so ``count=1`` exercises the
+  retry-only path and ``count > max_retries + 1`` forces the
+  rebuild+replay path deterministically);
+- ``serve_slow_step_at_step`` / ``serve_slow_step_seconds`` /
+  ``serve_slow_step_count`` — injected straggler decode steps
+  (``time.sleep`` inside the decode timing window), exercising the
+  slow-step anomaly detector, the degradation ladder and the
+  ``run_until_complete`` wall-clock timeout;
+- ``serve_storm_at_step`` / ``serve_storm_requests`` — a request-storm
+  burst at one serving step boundary (duplicates of the last submitted
+  request through the normal ``submit()`` path), exercising the
+  admission gate / load shedding under overload.
 
 The numeric/hang faults are keyed on **step attempts** (a monotonic count
 of dispatched steps) rather than ``global_steps``: a guardrails rollback
@@ -79,6 +95,13 @@ class FaultPlan:
     slice_preempt_slice: Optional[int] = None
     preempt_grace_seconds: float = 30.0
     rejoin_after_steps: Optional[int] = None
+    serve_decode_fault_at_step: Optional[int] = None
+    serve_decode_fault_count: int = 1
+    serve_slow_step_at_step: Optional[int] = None
+    serve_slow_step_seconds: float = 0.05
+    serve_slow_step_count: int = 1
+    serve_storm_at_step: Optional[int] = None
+    serve_storm_requests: int = 8
     max_attempt: int = 0
 
     def __post_init__(self):
@@ -92,6 +115,14 @@ class FaultPlan:
             raise ValueError("preempt_grace_seconds must be > 0")
         if self.rejoin_after_steps is not None and self.rejoin_after_steps < 1:
             raise ValueError("rejoin_after_steps must be >= 1")
+        if self.serve_decode_fault_count < 1:
+            raise ValueError("serve_decode_fault_count must be >= 1")
+        if self.serve_slow_step_seconds <= 0:
+            raise ValueError("serve_slow_step_seconds must be > 0")
+        if self.serve_slow_step_count < 1:
+            raise ValueError("serve_slow_step_count must be >= 1")
+        if self.serve_storm_requests < 1:
+            raise ValueError("serve_storm_requests must be >= 1")
         self._io_errors_left = int(self.ckpt_write_errors)
 
     # ------------------------------------------------------------------
@@ -206,6 +237,45 @@ class FaultPlan:
             self.slice_preempt_slice
             if self.slice_preempt_slice is not None else "<last>")
         os.kill(os.getpid(), signal.SIGTERM)
+
+    # -- serving chaos (serving/resilience.py; docs/SERVING.md) ---------
+    def should_serve_decode_fault(self, dispatch_attempt: int) -> bool:
+        """Raise on this decode DISPATCH attempt? Keyed on the engine's
+        monotonic dispatch-attempt counter (retries advance it, steps
+        never rewind), active for the window
+        ``[at_step, at_step + count)`` — so the count dials the depth of
+        the recovery path exercised (retry-only vs rebuild+replay)."""
+        return (self.serve_decode_fault_at_step is not None
+                and self.serve_decode_fault_at_step <= dispatch_attempt
+                < self.serve_decode_fault_at_step
+                + self.serve_decode_fault_count)
+
+    def serve_decode_fault(self, dispatch_attempt: int) -> None:
+        raise RuntimeError(
+            f"FaultPlan: injected serving decode-dispatch fault "
+            f"(dispatch attempt {dispatch_attempt})")
+
+    def should_serve_slow_step(self, dispatch_attempt: int) -> bool:
+        return (self.serve_slow_step_at_step is not None
+                and self.serve_slow_step_at_step <= dispatch_attempt
+                < self.serve_slow_step_at_step + self.serve_slow_step_count)
+
+    def serve_slow_step(self) -> None:
+        """Stall inside the decode timing window (the straggler-step
+        shape): the slow-step anomaly detector and the wall-clock
+        timeout are expected to see it."""
+        import time
+
+        logger.warning("FaultPlan: injecting slow serving step (%.3fs)",
+                       self.serve_slow_step_seconds)
+        time.sleep(self.serve_slow_step_seconds)
+
+    def should_serve_storm(self, serve_step: int) -> bool:
+        """Fire the request-storm burst at this serving step boundary?
+        Keyed on the engine step counter (serving steps never rewind),
+        exact match — the burst fires once."""
+        return (self.serve_storm_at_step is not None
+                and serve_step == self.serve_storm_at_step)
 
     def should_rejoin(self, step_attempt: int,
                       shrink_step_attempt: Optional[int]) -> bool:

@@ -12,11 +12,14 @@ entry point.
 from deepspeed_tpu.serving.engine import SERVING_METRIC_TAGS, ServeEngine
 from deepspeed_tpu.serving.kv_cache import (BlockPool, PagedLayerCache,
                                             init_paged_pools, pack_prefill)
+from deepspeed_tpu.serving.resilience import (TERMINAL_STATUSES,
+                                              ResilienceManager)
 from deepspeed_tpu.serving.scheduler import (PrefixCache, Request,
                                              Scheduler, Sequence)
 
 __all__ = [
     "BlockPool", "PagedLayerCache", "PrefixCache", "Request",
-    "SERVING_METRIC_TAGS", "ServeEngine", "Scheduler", "Sequence",
-    "init_paged_pools", "pack_prefill",
+    "ResilienceManager", "SERVING_METRIC_TAGS", "ServeEngine",
+    "Scheduler", "Sequence", "TERMINAL_STATUSES", "init_paged_pools",
+    "pack_prefill",
 ]

@@ -65,6 +65,15 @@ SERVING_METRIC_TAGS = frozenset({
     "serving/prefix_blocks_reused",
     "serving/spec_accept_rate",
     "serving/spec_tokens_per_verify",
+    # Serving resilience (docs/SERVING.md "Serving under failure"):
+    # emitted only when serving.resilience is on, so the off tag set
+    # stays byte-identical.
+    "serving/shed_requests",
+    "serving/deadline_expired",
+    "serving/cancelled",
+    "serving/recoveries",
+    "serving/retries",
+    "serving/degraded_level",
 })
 
 
@@ -85,7 +94,7 @@ class ServeEngine:
     def __init__(self, engine: InferenceEngine, config=None,
                  telemetry=None, capture_logits: bool = False,
                  measure_kv_quant_error: bool = False,
-                 request_accountant=None):
+                 request_accountant=None, fault_plan=None):
         from deepspeed_tpu.config.config import ServingConfig
         from deepspeed_tpu.telemetry import null_telemetry
 
@@ -165,6 +174,22 @@ class ServeEngine:
         if self._req_acc is not None:
             self._req_acc.spec_k = self._spec_k
             self.sched.accountant = self._req_acc
+        # Serving resilience (serving/resilience.py; docs/SERVING.md
+        # "Serving under failure"): deadlines + cancellation, SLO-aware
+        # load shedding, in-flight recovery, degradation ladder. None
+        # (the serving.resilience=off default) keeps every hook a single
+        # attribute check and the lowered decode program + emitted tag
+        # set byte-identical. Chaos (``fault_plan``) is independent: an
+        # injected serve fault with resilience off crashes the loop —
+        # the failure mode the manager exists to absorb.
+        self._fault = fault_plan
+        self._dispatch_attempts = 0      # decode dispatches, fault-keyed
+        self._storm_template = None      # last submit args, for storms
+        if self.scfg.resilience:
+            from deepspeed_tpu.serving.resilience import ResilienceManager
+            self._resil = ResilienceManager(self)
+        else:
+            self._resil = None
         # Numerics observatory surface (telemetry/numerics.py): with the
         # int8 KV cache AND the numerics opt-in on
         # (``telemetry.numerics.enabled`` — init_serving plumbs it;
@@ -215,9 +240,17 @@ class ServeEngine:
     # submission / retrieval
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int,
-               eos_token_id: Optional[int] = None) -> int:
+               eos_token_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> int:
         """Queue one request; returns its request id. Never blocks —
-        admission happens at the next ``step()`` boundary."""
+        admission happens at the next ``step()`` boundary.
+
+        ``deadline_ms`` (requires ``serving.resilience``): wall-clock
+        budget from submission; past it the request is aborted at the
+        next step boundary with status ``deadline_expired`` and whatever
+        tokens it produced. With resilience on, the admission gate may
+        also refuse the request outright — the returned rid then maps to
+        a terminal ``results`` record with status ``shed``."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -244,12 +277,47 @@ class ServeEngine:
                 f"request needs {need} KV blocks but the pool holds "
                 f"{self.pool.capacity} — it could never be admitted; "
                 f"raise serving.kv_num_blocks")
+        if deadline_ms is not None:
+            if self._resil is None:
+                raise ValueError(
+                    "deadline_ms requires serving.resilience.enabled "
+                    "(docs/SERVING.md 'Serving under failure')")
+            if deadline_ms <= 0:
+                raise ValueError(
+                    f"deadline_ms must be > 0, got {deadline_ms}")
         eos = eos_token_id if eos_token_id is not None \
             else self.scfg.eos_token_id
+        if self._fault is not None:
+            self._storm_template = (list(prompt), int(max_new_tokens),
+                                    eos_token_id, deadline_ms)
+        if self._resil is not None:
+            reason = self._resil.admission_gate(prompt,
+                                                int(max_new_tokens))
+            if reason is not None:
+                return self._resil.shed(prompt, int(max_new_tokens),
+                                        eos, reason)
         rid = self.sched.submit(prompt, int(max_new_tokens), eos)
+        req = self.sched.waiting[-1]
+        if self._resil is not None:
+            dl = (deadline_ms if deadline_ms is not None
+                  else self.scfg.resil_default_deadline_ms)
+            if dl is not None:
+                req.deadline = req.arrival + dl / 1e3
         if self._req_acc is not None:
-            self._req_acc.on_submit(self.sched.waiting[-1])
+            self._req_acc.on_submit(req)
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Flag a submitted request for cancellation; it is resolved at
+        the next step boundary — dropped from the queue, or aborted with
+        its partial output and terminal status ``cancelled``. Returns
+        False when the rid is unknown or already terminal. Requires
+        ``serving.resilience``."""
+        if self._resil is None:
+            raise RuntimeError(
+                "cancel() requires serving.resilience.enabled "
+                "(docs/SERVING.md 'Serving under failure')")
+        return self._resil.request_cancel(rid)
 
     def idle(self) -> bool:
         return self.sched.idle()
@@ -271,6 +339,15 @@ class ServeEngine:
         acc = self._req_acc
         if acc is not None:
             acc.engine_mark("host_idle")    # since the previous step
+
+        # -- resilience boundary: deadlines/cancellations resolve, then
+        # any scheduled chaos storm joins the queue (through submit(),
+        # i.e. through the shed gate) ----------------------------------
+        if self._resil is not None:
+            self._resil.process_boundary()
+        if self._fault is not None \
+                and self._fault.should_serve_storm(self._step_count):
+            self._inject_storm()
 
         # -- admission + prefill (the in-flight batching half) ----------
         for _ in range(self.scfg.max_prefills_per_step):
@@ -309,22 +386,12 @@ class ServeEngine:
         if active:
             if acc is not None:
                 n_djits = len(self._decode_jits) + len(self._spec_jits)
-            t_dec = time.perf_counter()
-            if self._spec_k:
-                n_tokens = self._spec_round(active, info)
-                dt_decode = time.perf_counter() - t_dec
+            if self._resil is not None:
+                n_tokens, dt_decode, active = self._resil.run_decode(
+                    active, info)
+                self._resil.note_step(dt_decode)
             else:
-                toks, logits = self._decode(active)
-                dt_decode = time.perf_counter() - t_dec
-                n_tokens = len(active)
-                for seq, tok in zip(active, toks):
-                    seq.tokens.append(int(tok))
-                    seq.pos += 1
-                    if seq.finished():
-                        self._finish(seq, info)
-                if self.capture_logits:
-                    info["logits"] = logits
-                    info["slots"] = {s.slot: s.request.rid for s in active}
+                n_tokens, dt_decode = self._decode_round(active, info)
             if acc is not None:
                 grew = (len(self._decode_jits)
                         + len(self._spec_jits)) > n_djits
@@ -335,16 +402,29 @@ class ServeEngine:
             self.stats["decode_steps"] += 1
             self.stats["occupancy_sum"] += \
                 len(active) / self.scfg.max_batch_size
+            # Cumulative decode rate lives OUTSIDE the telemetry gate:
+            # the admission gate's projected-wait fallback needs it even
+            # on a telemetry-free engine (two host floats, no syncs).
+            if n_tokens and dt_decode > 0:
+                self._decode_tokens += n_tokens
+                self._decode_sec += dt_decode
         # Gauges carry the SAME step index as this iteration's TTFT/
         # completion rows (emitted above) — increment only afterwards.
         self._emit_step_metrics(len(active), dt_decode, n_tokens)
         self._step_count += 1
         return info
 
-    def run_until_complete(self, max_steps: int = 100_000) -> Dict[int, Any]:
+    def run_until_complete(self, max_steps: int = 100_000,
+                           timeout_sec: Optional[float] = None
+                           ) -> Dict[int, Any]:
         """Drive ``step()`` until every submitted request has finished;
-        returns the results map (rid -> record)."""
+        returns the results map (rid -> record). ``timeout_sec`` is a
+        wall-clock bound: a wedged loop (a straggling dispatch, a stuck
+        backend) raises loudly with queue/active diagnostics instead of
+        spinning toward the step bound at whatever pace the wedge
+        allows."""
         steps = 0
+        t0 = time.monotonic()
         while not self.idle():
             self.step()
             steps += 1
@@ -353,6 +433,16 @@ class ServeEngine:
                     f"serving did not drain in {max_steps} steps "
                     f"(queue={self.sched.queue_depth}, "
                     f"running={len(self.sched.running)})")
+            if timeout_sec is not None \
+                    and time.monotonic() - t0 > timeout_sec:
+                waiting = [r.rid for r in self.sched.waiting]
+                running = {s.slot: s.request.rid
+                           for s in self.sched.running.values()}
+                raise RuntimeError(
+                    f"serving wall-clock timeout: not drained after "
+                    f"{timeout_sec:.3f}s ({steps} steps, "
+                    f"queue={self.sched.queue_depth} "
+                    f"rids={waiting[:8]}, running={running})")
         return self.results
 
     def serve_forever(self, should_stop=None, idle_sleep: float = 0.002):
@@ -382,16 +472,19 @@ class ServeEngine:
         n = self.stats["decode_steps"]
         return self.stats["occupancy_sum"] / n if n else 0.0
 
-    def _finish(self, seq: Sequence, info: Dict[str, Any]) -> None:
-        rid = seq.request.rid
+    def _result_record(self, seq: Sequence, status: str) -> Dict[str, Any]:
+        """Terminal record for an ADMITTED sequence — shared by the
+        happy path (``finished``) and the resilience terminals
+        (``deadline_expired``/``cancelled``/``aborted``), so the record
+        shape cannot drift between them. Latency fields are stamped
+        unconditionally — host floats the caller gets without telemetry
+        enabled."""
         req = seq.request
-        self.sched.finish(seq)
         now = time.monotonic()
-        # Latency fields are stamped unconditionally — host floats the
-        # caller gets without telemetry enabled.
-        self.results[rid] = {
+        return {
             "tokens": list(seq.tokens),
             "prompt_len": len(req.prompt),
+            "status": status,
             "slot": seq.slot,
             "finish_step": self._step_count,
             "ttft_ms": (req.first_token_time - req.arrival) * 1e3
@@ -402,6 +495,33 @@ class ServeEngine:
             if req.admitted_time is not None else None,
             "preempted_count": req.preempted_count,
         }
+
+    def _queue_record(self, req, status: str,
+                      reason: Optional[str] = None) -> Dict[str, Any]:
+        """Terminal record for a request that was NEVER admitted (shed,
+        cancelled/expired in the queue, torn down with the engine):
+        ``tokens`` is just the prompt, TTFT/queue-wait never existed."""
+        now = time.monotonic()
+        rec = {
+            "tokens": list(req.prompt),
+            "prompt_len": len(req.prompt),
+            "status": status,
+            "slot": None,
+            "finish_step": self._step_count,
+            "ttft_ms": None,
+            "finish_time": now,
+            "e2e_ms": (now - req.arrival) * 1e3,
+            "queue_wait_ms": None,
+            "preempted_count": req.preempted_count,
+        }
+        if reason is not None:
+            rec["shed_reason"] = reason
+        return rec
+
+    def _finish(self, seq: Sequence, info: Dict[str, Any]) -> None:
+        rid = seq.request.rid
+        self.sched.finish(seq)
+        self.results[rid] = self._result_record(seq, "finished")
         info["finished"].append(rid)
         tel = self.telemetry
         if tel.enabled:
@@ -499,6 +619,60 @@ class ServeEngine:
                     (now - seq.request.arrival) * 1e3,
                     step=self._step_count)
 
+    def _replay_prefill(self, seq: Sequence, replay: List[int]) -> None:
+        """Recovery replay (serving/resilience.py): rebuild ``seq``'s KV
+        ``[0, pos)`` in the fresh pools by prefilling its recorded
+        ``tokens[:-1]`` — through the SAME per-bucket prefill programs
+        as a cold/warm admission (pure functions, kept across the
+        rebuild). The sampled token is discarded: under greedy it equals
+        the already-recorded ``tokens[-1]``, whose KV is written by the
+        next decode step as usual. No TTFT observation, no token
+        append, no quant-error measure — the request already paid its
+        real prefill."""
+        t = len(replay)
+        rng = jax.random.fold_in(self._base_key, 2 * seq.request.rid + 1)
+        if seq.shared_len:
+            sl = seq.shared_len
+            tail = t - sl
+            mb_positions = self.max_blocks * self.block_size
+            tb = min(self._bucket_of(tail), mb_positions - sl)
+            ids = np.zeros((1, tb), np.int32)
+            ids[0, :tail] = replay[sl:]
+            bt = np.zeros((1, self.max_blocks), np.int32)
+            bt[0, :len(seq.block_table)] = seq.block_table
+            dev_ids, dev_bt = jnp.asarray(ids), jnp.asarray(bt)
+            start = jnp.asarray([sl], jnp.int32)
+            length = jnp.asarray(tail, jnp.int32)
+            self.engine.recompile_detector.check(
+                f"serving.prefill_tail_b{tb}", dev_ids, dev_bt, start,
+                length)
+            if tb not in self._tail_prefill_jit:
+                self._tail_prefill_jit[tb] = jax.jit(functools.partial(
+                    self._prefill_tail_impl, tail_bucket=tb),
+                    donate_argnums=(1,))
+            with self.telemetry.span("prefill", rid=seq.request.rid,
+                                     bucket=tb, prompt_len=t, replay=1):
+                _tok, self._pools = self._tail_prefill_jit[tb](
+                    self.engine.params, self._pools, dev_ids, dev_bt,
+                    start, length, rng)
+            return
+        bucket = seq.bucket
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :t] = replay
+        dev_ids = jnp.asarray(ids)
+        length = jnp.asarray(t, jnp.int32)
+        self.engine.recompile_detector.check(
+            f"serving.prefill_b{bucket}", dev_ids, length)
+        if bucket not in self._prefill_jit:
+            self._prefill_jit[bucket] = jax.jit(functools.partial(
+                self._prefill_impl, bucket=bucket))
+        with self.telemetry.span("prefill", rid=seq.request.rid,
+                                 bucket=bucket, prompt_len=t, replay=1):
+            _tok, _logits, ks, vs = self._prefill_jit[bucket](
+                self.engine.params, dev_ids, length, rng)
+            blocks = jnp.asarray(seq.block_table, jnp.int32)
+            self._pools = self._pack_jit(self._pools, blocks, ks, vs)
+
     def _prefill_tail_impl(self, params, pools, ids, bt, start, length,
                            rng, *, tail_bucket: int):
         # The tail writes [start, start + tail_bucket) — block-aligned
@@ -539,6 +713,50 @@ class ServeEngine:
         return tok, last, k_stack, v_stack
 
     # -- decode ---------------------------------------------------------
+    def _decode_round(self, active: List[Sequence],
+                      info: Dict[str, Any]):
+        """One decode (or speculative) round for the batch: dispatch,
+        append accepted tokens, finish rows that completed. Returns
+        ``(n_tokens, dt_decode)`` — the dispatch+fetch wall seconds the
+        throughput gauge and the accountant both key on. Host-side
+        extraction of the step() decode block (the lowered programs are
+        untouched); the resilience manager wraps THIS boundary, where a
+        failed dispatch has mutated nothing."""
+        t_dec = time.perf_counter()
+        if self._spec_k:
+            n_tokens = self._spec_round(active, info)
+            dt_decode = time.perf_counter() - t_dec
+        else:
+            toks, logits = self._decode(active)
+            dt_decode = time.perf_counter() - t_dec
+            n_tokens = len(active)
+            for seq, tok in zip(active, toks):
+                seq.tokens.append(int(tok))
+                seq.pos += 1
+                if seq.finished():
+                    self._finish(seq, info)
+            if self.capture_logits:
+                info["logits"] = logits
+                info["slots"] = {s.slot: s.request.rid for s in active}
+        return n_tokens, dt_decode
+
+    def _inject_storm(self) -> None:
+        """FaultPlan request storm: a burst of duplicates of the last
+        submitted request, through the normal ``submit()`` path — i.e.
+        through the shed gate when resilience is on (the overload
+        scenario the admission controller exists for)."""
+        if self._storm_template is None:
+            return
+        prompt, max_new, eos, dl = self._storm_template
+        n = self._fault.serve_storm_requests
+        log_dist(f"serving: FaultPlan request storm — {n} burst "
+                 f"submissions at step {self._step_count}", ranks=[0])
+        for _ in range(n):
+            if self._resil is not None:
+                self.submit(prompt, max_new, eos, deadline_ms=dl)
+            else:
+                self.submit(prompt, max_new, eos)
+
     def _batch_inputs(self, active: List[Sequence]):
         """Host-side decode batch matrices (inactive rows -> scratch)."""
         nb, mb = self.scfg.max_batch_size, self.max_blocks
@@ -571,6 +789,19 @@ class ServeEngine:
         bucket when capped), the jit-cache key, the resolved attention
         impl, and the gathered-positions evidence — ONE accounting for
         both paths so they cannot drift."""
+        if self._fault is not None:
+            # Serving chaos rides the decode DISPATCH attempt counter:
+            # monotonic across steps AND retries, so a fault window of
+            # width k is consumed by k dispatch attempts (a transient
+            # fault heals under retry; a wider window forces the
+            # rebuild path). Raising here mutates nothing — pools are
+            # only donated by a dispatch that actually runs.
+            self._dispatch_attempts += 1
+            if self._fault.should_serve_decode_fault(
+                    self._dispatch_attempts):
+                self._fault.serve_decode_fault(self._dispatch_attempts)
+            if self._fault.should_serve_slow_step(self._dispatch_attempts):
+                self._fault.serve_slow_step()
         mb = self.max_blocks
         bt, pos, toks = self._batch_inputs(active)
         if self._fast_path:
@@ -807,8 +1038,6 @@ class ServeEngine:
         reg.gauge("serving/queue_depth").set(self.sched.queue_depth,
                                              step=step)
         if n_tokens and dt_decode > 0:
-            self._decode_tokens += n_tokens
-            self._decode_sec += dt_decode
             reg.gauge("serving/tokens_per_sec").set(
                 self._decode_tokens / self._decode_sec, step=step)
         # Request observatory rides here (only when the accountant is on,
@@ -849,11 +1078,42 @@ class ServeEngine:
             reg.gauge("serving/spec_tokens_per_verify").set(
                 self.stats["spec_new_tokens"] / self.stats["spec_rounds"],
                 step=step)
+        # -- resilience transitions (only when the manager exists: the
+        # serving.resilience=off tag set stays byte-identical) ----------
+        if self._resil is not None:
+            reg.gauge("serving/degraded_level").set(
+                self._resil.degraded_level, step=step)
+            c = self._resil.counters
+            for tag, total in (
+                    ("serving/shed_requests", c["shed_requests"]),
+                    ("serving/deadline_expired", c["deadline_expired"]),
+                    ("serving/cancelled", c["cancelled"]),
+                    ("serving/recoveries", c["recoveries"]),
+                    ("serving/retries", c["retries"])):
+                ctr = reg.counter(tag)
+                if total > ctr.total:
+                    ctr.inc(total - ctr.total, step=step)
 
     def close(self) -> None:
         """Flush AND close the telemetry this engine drives (sink file
         handles, tracer, request records) — init_serving hands the
-        engine ownership."""
+        engine ownership. Any request still in flight or queued gets a
+        terminal ``aborted`` record first: every submitted rid resolves
+        through ``results``, even through a teardown."""
+        for seq in list(self.sched.running.values()):
+            rid = seq.request.rid
+            self.sched.abort(seq)
+            self.results[rid] = self._result_record(seq, "aborted")
+            if self._req_acc is not None:
+                slo = self._req_acc.on_finish(seq, self._step_count,
+                                              status="aborted")
+                if slo is not None:
+                    self.results[rid]["slo"] = slo
+        while self.sched.waiting:
+            req = self.sched.waiting.popleft()
+            self.results[req.rid] = self._queue_record(req, "aborted")
+            if self._req_acc is not None:
+                self._req_acc.on_drop(req, "aborted", self._step_count)
         if self._req_acc is not None:
             self._req_acc.close()
         self.telemetry.close()

@@ -69,6 +69,10 @@ class Request:
     # restarts — queue_wait is time until a slot was first granted, and
     # results[rid] carries it even with telemetry fully off.
     admitted_time: Optional[float] = None
+    # Absolute monotonic deadline (serving.resilience, docs/SERVING.md
+    # "Serving under failure"): past it the request is aborted at the
+    # next step boundary with status deadline_expired. None = no limit.
+    deadline: Optional[float] = None
 
 
 @dataclass
@@ -258,6 +262,11 @@ class Scheduler:
         self.waiting: Deque[Request] = collections.deque()
         self.running: Dict[int, Sequence] = {}            # slot -> seq
         self._free_slots: List[int] = list(range(self.num_slots))[::-1]
+        # Admission-level batch cap (<= num_slots). The degradation
+        # ladder (serving/resilience.py) shrinks it to shed batch
+        # pressure WITHOUT recompiling the decode program — slots above
+        # the cap simply stay empty, padding-masked like any idle slot.
+        self.slot_cap = int(num_slots)
         self._ids = itertools.count()
         self.preempted_total = 0
         self.completed_total = 0
@@ -273,6 +282,12 @@ class Scheduler:
         self.waiting.append(Request(rid, list(prompt), int(max_new_tokens),
                                     eos_token_id))
         return rid
+
+    def reserve_rid(self) -> int:
+        """Draw the next request id WITHOUT enqueuing anything — a shed
+        request (serving/resilience.py) still gets a real rid so its
+        terminal record lands in ``results`` like every other request."""
+        return next(self._ids)
 
     @property
     def queue_depth(self) -> int:
@@ -291,6 +306,8 @@ class Scheduler:
         covers its prompt bucket; returns the new Sequence (blocks
         allocated, not yet prefilled) or None."""
         if not self.waiting or not self._free_slots:
+            return None
+        if len(self.running) >= self.slot_cap:
             return None
         req = self.waiting[0]
         bucket = bucket_of(len(req.prompt))
@@ -399,6 +416,12 @@ class Scheduler:
     def finish(self, seq: Sequence) -> None:
         self._release(seq)
         self.completed_total += 1
+
+    def abort(self, seq: Sequence) -> None:
+        """Terminal eviction (deadline_expired / cancelled / teardown):
+        release slot + blocks exactly once, DON'T requeue — the caller
+        owns the terminal record."""
+        self._release(seq)
 
     def _release(self, seq: Sequence) -> None:
         del self.running[seq.slot]

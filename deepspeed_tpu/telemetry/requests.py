@@ -272,10 +272,14 @@ class RequestAccountant:
         st.phase = "queue"
         self._trace_to(st, "req/preempted")
 
-    def on_finish(self, seq, step: int) -> Optional[Dict[str, Any]]:
+    def on_finish(self, seq, step: int,
+                  status: str = "finished") -> Optional[Dict[str, Any]]:
         """Close the ledger: final TPOT slice, tail mark, aggregate into
         the cumulative gauges/counters, persist the JSONL record.
-        Returns the SLO dict the engine nests into ``results[rid]``."""
+        Returns the SLO dict the engine nests into ``results[rid]``.
+        ``status`` is the terminal status (``finished`` or a resilience
+        terminal: ``deadline_expired`` / ``cancelled`` / ``aborted``) —
+        an admitted request reaches this hook whichever way it ends."""
         st = self._states.pop(seq.request.rid, None)
         if st is None:
             return None
@@ -323,6 +327,8 @@ class RequestAccountant:
             "format": RECORD_FORMAT,
             "rid": req.rid,
             "host": self.host,
+            "status": status,
+            "admitted": True,
             "prompt_len": len(req.prompt),
             "new_tokens": seq.generated,
             "finish_step": step,
@@ -336,6 +342,40 @@ class RequestAccountant:
         }
         self._write(rec)
         return slo
+
+    def on_drop(self, request, status: str, step: int) -> None:
+        """A request left the system WITHOUT ever being admitted — shed
+        at submit time, cancelled/expired in the queue, or torn down with
+        the engine. It still gets a terminal JSONL record (every
+        submitted rid reaches one), but contributes NO registry metrics:
+        the ``requests/`` tag set must stay byte-identical whether or not
+        resilience is on, and never-admitted requests have no latency to
+        partition. Shed requests never pass :meth:`on_submit`, so a
+        missing state is expected."""
+        st = self._states.pop(request.rid, None)
+        now = time.monotonic()
+        if st is not None:
+            self._mark(st, "preempted_requeue" if st.requeued
+                       else "queue_wait", now)
+            self._trace_to(st, None)
+        queue_wait = (st.totals["queue_wait"] if st is not None
+                      else 0.0)
+        rec = {
+            "format": RECORD_FORMAT,
+            "rid": request.rid,
+            "host": self.host,
+            "status": status,
+            "admitted": False,
+            "prompt_len": len(request.prompt),
+            "new_tokens": 0,
+            "finish_step": step,
+            "arrival_unix": request.arrival + self._wall_offset,
+            "e2e_ms": (now - request.arrival) * 1e3,
+            "ttft_ms": None,
+            "queue_wait_ms": queue_wait * 1e3,
+            "preempted_count": request.preempted_count,
+        }
+        self._write(rec)
 
     # -- engine serving-time partition ---------------------------------
     def engine_mark(self, cat: str) -> None:

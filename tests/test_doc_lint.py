@@ -383,6 +383,12 @@ class TestDocDrift:
         assert {"serving/decode_attn_kernel", "serving/prefix_hits",
                 "serving/prefix_blocks_reused", "serving/spec_accept_rate",
                 "serving/spec_tokens_per_verify"} <= SERVING_METRIC_TAGS
+        # the resilience layer's counters/gauge likewise (docs/SERVING.md
+        # "Serving under failure")
+        assert {"serving/shed_requests", "serving/deadline_expired",
+                "serving/cancelled", "serving/retries",
+                "serving/recoveries",
+                "serving/degraded_level"} <= SERVING_METRIC_TAGS
 
     def test_request_tags_documented_and_vice_versa(self):
         """The request-observatory surface (telemetry/requests.py) is

@@ -1,0 +1,484 @@
+"""Serving-under-failure tests — deadlines, shedding, chaos recovery.
+
+The acceptance gates of docs/SERVING.md "Serving under failure":
+
+- **deadline expiry** aborts a running sequence at a decode-step
+  boundary KEEPING its partial output, and drops a queued request
+  without ever admitting it; **cancel(rid)** does the same on demand,
+  releasing every KV block exactly once (the pool drains to zero);
+- **admission control** sheds past the depth backstop and past the
+  projected-queue-wait gate with a terminal ``shed`` record per rid —
+  under a FaultPlan request storm the admitted requests' queue wait
+  stays bounded instead of collapsing with everyone else's;
+- **in-flight recovery**: an injected decode-dispatch fault heals
+  through retry (transient) or rebuild + replay (persistent) and the
+  surviving requests finish token-identical to the fault-free run;
+- the **degradation ladder** climbs spec-off → gather attention →
+  halved batch cap and never past rung 3;
+- ``run_until_complete(timeout_sec=...)`` raises loudly with queue
+  diagnostics when the loop wedges (injected slow-step fault);
+- the **zero-overhead off-contract**: with ``serving.resilience`` off
+  the emitted tag set is byte-identical to the resilience-free engine
+  and the loop performs zero device syncs;
+- **terminal completeness** end to end through ``init_serving``: every
+  submitted rid — finished, shed, cancelled in queue, or torn down
+  with the engine — reaches ``results[rid]`` AND a ``requests.jsonl``
+  record with its terminal status.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config.config import ServingConfig
+from deepspeed_tpu.models import make_gpt
+from deepspeed_tpu.resilience import FaultPlan
+from deepspeed_tpu.serving import TERMINAL_STATUSES, ServeEngine
+from deepspeed_tpu.telemetry import (InMemorySink, MetricsRegistry,
+                                     RecompileDetector, StepTracer,
+                                     Telemetry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The resilience-free engine's emitted tag set on a simple trace —
+# identical to test_serving_slo.BASELINE_SIMPLE_TAGS; the off-contract
+# pins it EXACTLY, so the resilience rows can never leak into it.
+BASELINE_SIMPLE_TAGS = {
+    "serving/ttft_ms", "serving/batch_occupancy",
+    "serving/kv_blocks_in_use", "serving/queue_depth",
+    "serving/tokens_per_sec", "serving/requests_completed",
+}
+RESIL_TAGS = {
+    "serving/shed_requests", "serving/deadline_expired",
+    "serving/cancelled", "serving/recoveries", "serving/retries",
+    "serving/degraded_level",
+}
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    # fp32 like test_serving.py: argmax tie-flips are noise at bf16.
+    model, cfg = make_gpt("tiny", dropout_rate=0.0, max_seq_len=64,
+                          dtype=jnp.float32)
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)},
+                        {"input_ids": np.zeros((1, 8), np.int32)})["params"]
+    return model, cfg, params
+
+
+def _serve(model, params, fault=None, telemetry=None, **overrides):
+    scfg = ServingConfig(**{
+        "max_batch_size": 2, "kv_block_size": 4, "kv_num_blocks": 64,
+        "max_model_len": 48, **overrides})
+    eng = deepspeed_tpu.init_inference(model, params=params,
+                                       dtype=jnp.float32)
+    plan = FaultPlan.resolve(fault) if fault else None
+    return ServeEngine(eng, config=scfg, telemetry=telemetry,
+                       fault_plan=plan)
+
+
+def _mem_telemetry():
+    reg = MetricsRegistry()
+    sink = reg.add_sink(InMemorySink())
+    tracer = StepTracer(path=None, enabled=False, sync_spans=False)
+    return Telemetry(reg, tracer, RecompileDetector(enabled=False)), sink
+
+
+def _prompts(cfg, n=3, seed=17):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, (4 + i,)).tolist()
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Deadlines + cancellation
+# ---------------------------------------------------------------------------
+
+class TestDeadlinesAndCancel:
+    def test_deadline_expiry_keeps_partial_output(self, gpt_setup):
+        """A running sequence whose deadline passes is aborted at the
+        next step boundary with its partial output in the terminal
+        record, and every KV block it held is released."""
+        model, cfg, params = gpt_setup
+        srv = _serve(model, params, resilience=True)
+        prompt = _prompts(cfg, n=1)[0]
+        rid = srv.submit(prompt, 30, deadline_ms=60_000.0)
+        # the stamp is absolute: arrival + deadline
+        req = srv.sched.waiting[0]
+        assert req.deadline == pytest.approx(req.arrival + 60.0, abs=1e-6)
+        for _ in range(3):
+            srv.step()
+        seq = next(iter(srv.sched.running.values()))
+        n_partial = len(seq.tokens)
+        assert n_partial > len(prompt)          # generated something
+        seq.request.deadline = time.monotonic() - 1.0   # force expiry
+        srv.step()
+        rec = srv.results[rid]
+        assert rec["status"] == "deadline_expired"
+        assert len(prompt) < len(rec["tokens"]) < len(prompt) + 1 + 30
+        assert rec["tokens"][:len(prompt)] == prompt
+        assert srv._resil.counters["deadline_expired"] == 1
+        assert srv.pool.used_blocks == 0        # released exactly once
+        assert srv.idle()
+
+    def test_queued_deadline_drops_without_admission(self, gpt_setup):
+        """A request that expires while still queued terminates without
+        ever taking a slot: tokens == prompt, no queue-wait stamp."""
+        model, cfg, params = gpt_setup
+        srv = _serve(model, params, resilience=True)
+        p = _prompts(cfg, n=3)
+        r0 = srv.submit(p[0], 12)
+        r1 = srv.submit(p[1], 12)
+        r2 = srv.submit(p[2], 12, deadline_ms=0.5)
+        time.sleep(0.01)                        # let the 0.5ms pass
+        res = srv.run_until_complete(timeout_sec=120.0)
+        assert res[r2]["status"] == "deadline_expired"
+        assert res[r2]["tokens"] == p[2]
+        assert res[r2]["queue_wait_ms"] is None
+        assert res[r0]["status"] == res[r1]["status"] == "finished"
+        assert srv.pool.used_blocks == 0
+
+    def test_cancel_releases_blocks_exactly_once(self, gpt_setup):
+        """cancel(rid) on a RUNNING sequence resolves at the next step
+        boundary: partial output kept, blocks freed (the BlockPool
+        refcounts raise on a double free, so draining to zero is the
+        structural leak check), and the other request is undisturbed."""
+        model, cfg, params = gpt_setup
+        srv = _serve(model, params, resilience=True)
+        p = _prompts(cfg, n=2)
+        r0 = srv.submit(p[0], 20)
+        r1 = srv.submit(p[1], 6)
+        for _ in range(3):
+            srv.step()
+        assert srv.cancel(r0)
+        assert not srv.cancel(r0 + 999)         # unknown rid
+        res = srv.run_until_complete(timeout_sec=120.0)
+        assert res[r0]["status"] == "cancelled"
+        assert len(p[0]) < len(res[r0]["tokens"]) < len(p[0]) + 1 + 20
+        assert res[r1]["status"] == "finished"
+        assert srv._resil.counters["cancelled"] == 1
+        assert srv.pool.used_blocks == 0
+        assert not srv.cancel(r1)               # already terminal
+
+    def test_cancel_in_queue_and_off_wall(self, gpt_setup):
+        """A queued rid cancels without admission; cancel() without the
+        resilience layer is a loud error, not a silent no-op."""
+        model, cfg, params = gpt_setup
+        srv = _serve(model, params, resilience=True, max_batch_size=2)
+        p = _prompts(cfg, n=3)
+        rids = [srv.submit(pp, 8) for pp in p]
+        assert srv.cancel(rids[2])              # still queued (2 slots)
+        res = srv.run_until_complete(timeout_sec=120.0)
+        assert res[rids[2]]["status"] == "cancelled"
+        assert res[rids[2]]["tokens"] == p[2]
+        assert {res[r]["status"] for r in rids[:2]} == {"finished"}
+
+        off = _serve(model, params)
+        with pytest.raises(RuntimeError, match="resilience"):
+            off.cancel(0)
+
+
+# ---------------------------------------------------------------------------
+# Admission control + load shedding
+# ---------------------------------------------------------------------------
+
+class TestAdmissionControl:
+    def test_depth_backstop_sheds_with_terminal_records(self, gpt_setup):
+        """Past max_queue_depth every submit returns a real rid whose
+        terminal ``shed`` record (with the gate's reason) is already in
+        results — and the admitted work all finishes."""
+        model, cfg, params = gpt_setup
+        srv = _serve(model, params, resilience=True,
+                     resil_max_queue_depth=2)
+        rng = np.random.default_rng(3)
+        rids = [srv.submit(rng.integers(0, cfg.vocab_size, (5,)).tolist(),
+                           6) for _ in range(8)]
+        shed = [r for r in rids if r in srv.results]
+        assert shed and len(shed) == srv._resil.counters["shed_requests"]
+        for r in shed:
+            assert srv.results[r]["status"] == "shed"
+            assert "max_queue_depth" in srv.results[r]["shed_reason"]
+        res = srv.run_until_complete(timeout_sec=120.0)
+        assert set(res) == set(rids)            # every rid terminal
+        assert all(res[r]["status"] in ("finished", "shed") for r in rids)
+        assert [r for r in rids if res[r]["status"] == "finished"]
+
+    def test_projected_wait_gate(self, gpt_setup):
+        """With decode-rate evidence, a submission whose projected queue
+        wait blows max_queue_wait_ms sheds on projection — the cold
+        engine (no evidence) admits unconditionally."""
+        model, cfg, params = gpt_setup
+        srv = _serve(model, params, resilience=True,
+                     resil_max_queue_wait_ms=0.01)
+        p = _prompts(cfg, n=3)
+        r0 = srv.submit(p[0], 8)                # cold: no rate evidence
+        srv.run_until_complete(timeout_sec=120.0)
+        assert srv.results[r0]["status"] == "finished"
+        # now the engine has a measured decode rate: a queued 30-token
+        # request projects far past the 0.01ms budget
+        r1 = srv.submit(p[1], 30)
+        r2 = srv.submit(p[2], 30)
+        assert r2 in srv.results
+        assert srv.results[r2]["status"] == "shed"
+        assert "queue wait" in srv.results[r2]["shed_reason"]
+        res = srv.run_until_complete(timeout_sec=120.0)
+        assert res[r1]["status"] == "finished"
+
+    def test_storm_shed_keeps_admitted_queue_wait_bounded(self, gpt_setup):
+        """The headline property under a FaultPlan request storm: with
+        shedding ON the admitted requests' worst queue wait is strictly
+        below the no-shedding run's worst (where every storm duplicate
+        queues up in front of someone)."""
+        model, cfg, params = gpt_setup
+        # the storm fires AFTER a warmup request has compiled every
+        # program: queue waits then measure service time, not jit time
+        storm = {"serve_storm_at_step": 10_000, "serve_storm_requests": 12}
+        waits = {}
+        for mode, overrides in (
+                ("off", {}),
+                ("on", {"resilience": True, "resil_max_queue_depth": 2})):
+            srv = _serve(model, params, fault=storm, **overrides)
+            rng = np.random.default_rng(11)
+            warm = srv.submit(
+                rng.integers(0, cfg.vocab_size, (6,)).tolist(), 4)
+            srv.run_until_complete(timeout_sec=120.0)
+            # fire the storm 4 steps into the measured trace — while
+            # the first batch decodes and the second is still queued
+            srv._fault.serve_storm_at_step = srv._step_count + 4
+            for _ in range(4):
+                srv.submit(rng.integers(0, cfg.vocab_size, (6,)).tolist(),
+                           8)
+            res = srv.run_until_complete(timeout_sec=120.0)
+            del res[warm]
+            assert len(res) == 4 + 12
+            waits[mode] = [r["queue_wait_ms"] for r in res.values()
+                           if r["status"] == "finished"
+                           and r["queue_wait_ms"] is not None]
+            if mode == "on":
+                n_shed = sum(1 for r in res.values()
+                             if r["status"] == "shed")
+                assert n_shed > 0
+                assert all(r["status"] in ("finished", "shed")
+                           for r in res.values())
+            else:
+                assert all(r["status"] == "finished"
+                           for r in res.values())
+        # 12 duplicates over a depth-2 queue vs an unbounded one: the
+        # margin is an order of magnitude, not a timing coin flip
+        assert max(waits["on"]) < max(waits["off"])
+
+
+# ---------------------------------------------------------------------------
+# In-flight recovery + degradation ladder
+# ---------------------------------------------------------------------------
+
+class TestRecovery:
+    def test_fault_retry_and_rebuild_are_token_identical(self, gpt_setup):
+        """The chaos e2e: a transient decode-dispatch fault heals inside
+        the retry budget (no rebuild); a persistent window exhausts it
+        and forces rebuild + replay — both finish every request with
+        output identical to the fault-free run."""
+        model, cfg, params = gpt_setup
+        p = _prompts(cfg, n=3)
+        outs = [10, 6, 8]
+
+        def run(fault):
+            srv = _serve(model, params, fault=fault, resilience=True,
+                         resil_retry_base_sec=0.01)
+            rids = [srv.submit(pp, n) for pp, n in zip(p, outs)]
+            res = srv.run_until_complete(timeout_sec=120.0)
+            return [res[r]["tokens"] for r in rids], srv._resil.counters
+
+        base, _ = run(None)
+        transient, c1 = run({"serve_decode_fault_at_step": 3})
+        assert transient == base
+        assert c1["retries"] >= 1 and c1["recoveries"] == 0
+        persistent, c2 = run({"serve_decode_fault_at_step": 3,
+                              "serve_decode_fault_count": 3})
+        assert persistent == base
+        assert c2["recoveries"] >= 1
+
+    def test_fault_without_resilience_crashes_the_loop(self, gpt_setup):
+        """The motivating failure: the same injected fault with the
+        resilience layer off propagates out of step()."""
+        model, cfg, params = gpt_setup
+        srv = _serve(model, params,
+                     fault={"serve_decode_fault_at_step": 1})
+        srv.submit(_prompts(cfg, n=1)[0], 8)
+        with pytest.raises(RuntimeError, match="injected serving"):
+            srv.run_until_complete(timeout_sec=120.0)
+
+    def test_degradation_ladder(self, gpt_setup):
+        """Anomalies climb spec-off -> gather attention -> halved batch
+        cap, one rung per degrade_after, capped at 3."""
+        model, cfg, params = gpt_setup
+        srv = _serve(model, params, resilience=True,
+                     resil_degrade_after=2, spec_decode=True, spec_k=2)
+        resil = srv._resil
+        assert srv._spec_k == 2
+        resil.note_anomaly()
+        assert resil.degraded_level == 0
+        resil.note_anomaly()
+        assert resil.degraded_level == 1 and srv._spec_k == 0
+        resil.note_anomaly()
+        resil.note_anomaly()
+        assert resil.degraded_level == 2 and srv._attn_impl == "gather"
+        resil.note_anomaly()
+        resil.note_anomaly()
+        assert resil.degraded_level == 3
+        assert srv.sched.slot_cap == 1          # max_batch_size 2 halved
+        for _ in range(6):                      # rungs never un-climb,
+            resil.note_anomaly()                # never past 3
+        assert resil.degraded_level == 3
+        # the capped engine still serves correctly (slots padding-masked)
+        rids = [srv.submit(pp, 5) for pp in _prompts(cfg, n=2)]
+        res = srv.run_until_complete(timeout_sec=120.0)
+        assert all(res[r]["status"] == "finished" for r in rids)
+
+
+# ---------------------------------------------------------------------------
+# Wedged-loop wall clock
+# ---------------------------------------------------------------------------
+
+class TestWedgeTimeout:
+    def test_run_until_complete_timeout_raises_with_diagnostics(
+            self, gpt_setup):
+        """Regression for the wall-clock knob: an injected slow-step
+        wedge makes the loop blow timeout_sec and the error names the
+        queue state instead of spinning toward max_steps."""
+        model, cfg, params = gpt_setup
+        srv = _serve(model, params,
+                     fault={"serve_slow_step_at_step": 0,
+                            "serve_slow_step_seconds": 0.4,
+                            "serve_slow_step_count": 100_000})
+        srv.submit(_prompts(cfg, n=1)[0], 30)
+        with pytest.raises(RuntimeError,
+                           match="wall-clock timeout") as exc:
+            srv.run_until_complete(timeout_sec=0.3)
+        assert "running=" in str(exc.value)
+        assert "queue=" in str(exc.value)
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead off-contract
+# ---------------------------------------------------------------------------
+
+class TestOffContract:
+    def test_off_tag_set_and_sync_count_unchanged(self, gpt_setup,
+                                                  monkeypatch):
+        """serving.resilience off: no manager, no fault hook state, the
+        emitted tag set byte-identical to the resilience-free engine,
+        zero device syncs in the loop."""
+        model, cfg, params = gpt_setup
+        tel, sink = _mem_telemetry()
+        srv = _serve(model, params, telemetry=tel)
+        assert srv._resil is None and srv._fault is None
+        from deepspeed_tpu.utils import timer as timer_mod
+        calls = {"n": 0}
+        monkeypatch.setattr(timer_mod, "_device_synchronize",
+                            lambda: calls.__setitem__("n", calls["n"] + 1))
+        for pp in _prompts(cfg, n=3):
+            srv.submit(pp, 6)
+        srv.run_until_complete(timeout_sec=120.0)
+        assert calls["n"] == 0
+        assert sink.tags() == BASELINE_SIMPLE_TAGS
+        assert not (sink.tags() & RESIL_TAGS)
+        # the one-decode-program contract still holds verbatim
+        det = srv.engine.recompile_detector
+        assert det.compiles("serving.decode_step") == 1
+        assert det.retraces("serving.decode_step") == 0
+
+    def test_on_emits_the_resilience_rows(self, gpt_setup):
+        """With the layer on, degraded_level is always present and the
+        transition counters appear exactly when their event fires."""
+        model, cfg, params = gpt_setup
+        tel, sink = _mem_telemetry()
+        srv = _serve(model, params, telemetry=tel, resilience=True,
+                     resil_max_queue_depth=1)
+        rng = np.random.default_rng(9)
+        for _ in range(5):
+            srv.submit(rng.integers(0, cfg.vocab_size, (5,)).tolist(), 6)
+        srv.run_until_complete(timeout_sec=120.0)
+        tags = sink.tags()
+        assert {"serving/degraded_level",
+                "serving/shed_requests"} <= tags
+        assert BASELINE_SIMPLE_TAGS <= tags
+
+
+# ---------------------------------------------------------------------------
+# Terminal completeness end to end (init_serving + requests.jsonl)
+# ---------------------------------------------------------------------------
+
+class TestTerminalCompleteness:
+    def test_every_rid_terminal_in_results_and_jsonl(self, gpt_setup,
+                                                     tmp_path):
+        """Finished, shed, cancelled-in-queue and torn-down requests ALL
+        land in results AND requests.jsonl with a terminal status;
+        percentile-bearing fields exist only on admitted records."""
+        model, cfg, params = gpt_setup
+        srv = deepspeed_tpu.init_serving(
+            model, params=params, dtype=jnp.float32,
+            config={
+                "serving": {"max_batch_size": 2, "kv_block_size": 4,
+                            "kv_num_blocks": 64, "max_model_len": 48,
+                            "resilience": {"max_queue_depth": 3}},
+                "telemetry": {"enabled": True, "dir": str(tmp_path),
+                              "requests": {"enabled": True}}})
+        rng = np.random.default_rng(23)
+        r_fin = srv.submit(rng.integers(0, cfg.vocab_size, (5,)).tolist(),
+                           6)
+        srv.run_until_complete(timeout_sec=120.0)
+        burst = [srv.submit(rng.integers(0, cfg.vocab_size,
+                                         (5,)).tolist(), 20)
+                 for _ in range(6)]
+        rids = [r_fin] + burst
+        shed = [r for r in burst if r in srv.results]
+        live = [r for r in burst if r not in srv.results]
+        assert shed and len(live) == 3
+        assert srv.cancel(live[-1])             # still queued (2 slots)
+        srv.step()
+        srv.step()
+        srv.close()                             # tears down in-flight
+        assert set(srv.results) == set(rids)
+        statuses = {r: srv.results[r]["status"] for r in rids}
+        assert set(statuses.values()) <= set(TERMINAL_STATUSES)
+        assert statuses[r_fin] == "finished"
+        assert statuses[live[-1]] == "cancelled"
+        assert all(statuses[r] == "shed" for r in shed)
+        assert "aborted" in statuses.values()
+
+        with open(os.path.join(str(tmp_path), "requests.jsonl")) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        assert len(records) == len(rids)
+        by_status = {}
+        for rec in records:
+            by_status.setdefault(rec["status"], []).append(rec)
+            if not rec["admitted"]:
+                assert rec["new_tokens"] == 0
+                assert rec["ttft_ms"] is None
+        assert set(by_status) == set(statuses.values())
+        assert len(by_status["shed"]) == len(shed)
+
+
+# ---------------------------------------------------------------------------
+# Probe CLI (tier-1 hook)
+# ---------------------------------------------------------------------------
+
+def test_probe_serving_resilience_selftest():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "probe_serving_resilience.py"),
+         "--selftest"], capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "selftest ok" in proc.stdout
+    assert "token-identical" in proc.stdout
+    assert "load shedding" in proc.stdout

@@ -52,6 +52,10 @@ ENGINE_CATEGORIES = ("prefill", "decode", "scheduler_admission",
 TPOT_TAG = "requests/tpot_ms"
 ENGINE_WALL_TAG = "requests/engine_wall_sec"
 
+# Terminal statuses a record can carry (serving/resilience.py
+# TERMINAL_STATUSES; records predating the status field are finished).
+STATUSES = ("finished", "shed", "deadline_expired", "cancelled", "aborted")
+
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
     """Linear-interpolated percentile over an already-sorted list."""
@@ -130,26 +134,41 @@ def collect(run_dir: str,
         if ENGINE_WALL_TAG in last:
             engine_wall += last[ENGINE_WALL_TAG]
 
+    # Terminal-status breakdown: percentiles are computed over ADMITTED
+    # requests only — a shed request's sub-millisecond "e2e" is a policy
+    # artifact, and mixing it in would make an overloaded, shedding
+    # engine look faster than a healthy one. Records predating the
+    # status/admitted fields count as admitted+finished.
+    status_counts: Dict[str, int] = {}
+    for r in records:
+        s = r.get("status", "finished")
+        status_counts[s] = status_counts.get(s, 0) + 1
+    admitted = [r for r in records if r.get("admitted", True)]
+
     report: Dict[str, Any] = {
         "record_files": [os.path.basename(p) for p in rec_paths],
         "metric_files": [os.path.basename(p) for p in met_paths],
         "n_requests": len(records),
+        "n_admitted": len(admitted),
+        "status_counts": status_counts,
+        "shed_frac": (status_counts.get("shed", 0) / len(records)
+                      if records else None),
         "hosts": sorted({r.get("host") for r in records
                          if r.get("host") is not None}),
     }
-    report["ttft_ms"] = _pcts([r.get("ttft_ms") for r in records])
+    report["ttft_ms"] = _pcts([r.get("ttft_ms") for r in admitted])
     report["tpot_ms"] = (_pcts(tpot_obs) if tpot_obs
                          else _pcts([r.get("tpot_mean_ms")
-                                     for r in records]))
+                                     for r in admitted]))
     report["tpot_source"] = ("metrics" if tpot_obs
-                             else "records" if records else None)
-    report["e2e_ms"] = _pcts([r.get("e2e_ms") for r in records])
+                             else "records" if admitted else None)
+    report["e2e_ms"] = _pcts([r.get("e2e_ms") for r in admitted])
     report["queue_wait_ms"] = _pcts([r.get("queue_wait_ms")
-                                     for r in records])
+                                     for r in admitted])
 
     # -- time lost per category (exact partition, summed) ---------------
     cat_sec = {c: 0.0 for c in CATEGORIES}
-    for r in records:
+    for r in admitted:
         cats = r.get("categories") or {}
         for c in CATEGORIES:
             cat_sec[c] += float(cats.get(c, 0.0))
@@ -185,6 +204,15 @@ def render(report: Dict[str, Any]) -> str:
                f"  ({report['n_requests']} requests"
                + (f", hosts {', '.join(report['hosts'])}"
                   if report["hosts"] else "") + ")")
+    counts = report.get("status_counts") or {}
+    if set(counts) - {"finished"}:
+        parts = [f"{s} {counts[s]}" for s in STATUSES if counts.get(s)]
+        parts += [f"{s} {n}" for s, n in sorted(counts.items())
+                  if s not in STATUSES]
+        shed = report.get("shed_frac") or 0.0
+        out.append(f"  terminal status  {'  '.join(parts)}"
+                   f"  (shed {shed:.1%}; percentiles over "
+                   f"{report['n_admitted']} admitted)")
     for label, key in (("TTFT", "ttft_ms"), ("TPOT", "tpot_ms"),
                        ("e2e", "e2e_ms"), ("queue wait", "queue_wait_ms")):
         p = report.get(key)
@@ -274,8 +302,29 @@ def _selftest() -> int:
                 f.write(json.dumps({"tag": tag, "value": v, "step": 9,
                                     "kind": "gauge"}) + "\n")
 
+        # Terminal-status records (serving/resilience.py): shed/expired
+        # requests must show in the breakdown but NOT in the percentiles
+        # — their sub-ms "latency" would fake a fast engine.
+        with open(os.path.join(td, "requests.hostA.jsonl"), "a") as f:
+            f.write("\n")                 # terminate the torn tail line
+            for i, status in enumerate(("shed", "shed",
+                                        "deadline_expired")):
+                f.write(json.dumps(
+                    {"format": 1, "rid": 100 + i, "host": "hostA",
+                     "status": status, "admitted": False,
+                     "prompt_len": 8, "new_tokens": 0, "finish_step": 0,
+                     "e2e_ms": 0.3, "ttft_ms": None,
+                     "queue_wait_ms": None}) + "\n")
+
         report = collect(td)
-        assert report["n_requests"] == 11, report
+        assert report["n_requests"] == 14, report
+        assert report["n_admitted"] == 11, report
+        assert report["status_counts"] == {
+            "finished": 11, "shed": 2, "deadline_expired": 1}, report
+        assert abs(report["shed_frac"] - 2 / 14) < 1e-9, report
+        # admitted-only percentiles: the 0.3ms shed rows must not drag
+        # e2e down
+        assert report["e2e_ms"]["n"] == 11, report
         assert report["hosts"] == ["hostA", "hostB"], report
         # e2e over 100..190 + 500: p50 is the 6th of 11 sorted values
         assert abs(report["e2e_ms"]["p50"] - 150.0) < 1e-6, report
@@ -300,6 +349,8 @@ def _selftest() -> int:
         assert "TPOT" in text and "time lost" in text
         assert "prefix cache" in text and "preemptions" in text
         assert "engine serving-time partition" in text
+        assert "terminal status" in text and "shed 2" in text, text
+        assert "11 admitted" in text, text
         json.dumps(report)                          # serializable
 
         # TPOT falls back to per-record means without metric rows
