@@ -547,6 +547,116 @@ def bench_serving_overload(n_requests=24):
     }
 
 
+def bench_serving_chunked():
+    """Chunked-prefill admission A/B (docs/SERVING.md "Chunked prefill
+    admission"): the same bursty trace — a burst of prompts whose lengths
+    span several prefill buckets — served by the bucketed per-bucket
+    prefill programs vs the single ragged mixed program. Cold engines on
+    both sides: the bucketed path pays one compile per bucket it meets
+    INSIDE the burst's TTFT window, the chunked path compiles its one
+    mixed program once, which is the headline latency win on any
+    backend. Outputs are asserted token-identical (same greedy trace).
+    Rows: mean decode/mixed step wall ms + TTFT p99 per mode."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import make_gpt
+
+    model, cfg = make_gpt("tiny", dropout_rate=0.0, max_seq_len=128,
+                          dtype=jnp.float32)
+    rng = np.random.default_rng(5)
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "dropout": jax.random.PRNGKey(1)},
+                        {"input_ids": np.zeros((1, 8), np.int32)})["params"]
+    # Bursty: lengths span >= 3 prefill buckets, all submitted at once.
+    lens = [10, 20, 40, 70, 12, 44, 22, 68]
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).tolist()
+               for n in lens]
+    outs = [int(rng.integers(8, 20)) for _ in lens]
+
+    def run(chunked):
+        srv = deepspeed_tpu.init_serving(
+            model, params=params, dtype=jnp.float32,
+            config={"serving": {
+                        **SERVING_BENCH_CFG,
+                        "chunked_prefill": {"enabled": chunked,
+                                            "token_budget": 32}},
+                    "telemetry": {"enabled": True, "dir": ".",
+                                  "metrics": {"sinks": ["memory"]},
+                                  "trace": {"enabled": False}}})
+        for p, n in zip(prompts, outs):
+            srv.submit(p, n)
+        res = srv.run_until_complete()
+        toks = [res[r]["tokens"] for r in sorted(res)]
+        ms = 1e3 * srv._decode_sec / max(1, srv.stats["decode_steps"])
+        p99 = srv.telemetry.registry.histogram(
+            "serving/ttft_ms").percentile(99)
+        return toks, ms, p99
+
+    toks_b, ms_b, p99_b = run(False)
+    toks_c, ms_c, p99_c = run(True)
+    assert toks_c == toks_b, "chunked admission diverged from bucketed"
+    return {
+        "mixed_step_bucketed_ms": round(ms_b, 3),
+        "mixed_step_chunked_ms": round(ms_c, 3),
+        "ttft_p99_bucketed_ms": round(p99_b, 2),
+        "ttft_p99_chunked_ms": round(p99_c, 2),
+    }
+
+
+def bench_fused_optimizer():
+    """Fused blockwise Adam A/B (docs/PERFORMANCE.md "Kernel tier round
+    2"): jitted XLA elementwise update chain vs the single-pass Pallas
+    kernel over the same ~1M-element parameter tree, single device.
+    Trajectories are asserted to match (the kernel bit-matches the op
+    order, tests/test_fused_update.py); off-TPU the kernel runs through
+    the Pallas interpreter, so this row exists for a TPU round to show
+    the HBM round-trip win. Rows: mean optimizer step wall ms per
+    mode."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+    from deepspeed_tpu.ops.adam.fused_update import fused_adam_apply
+
+    rng = np.random.default_rng(3)
+    params = {
+        "dense": jnp.asarray(rng.standard_normal((1024, 768)), jnp.float32),
+        "embed": jnp.asarray(rng.standard_normal((512, 512)), jnp.float32),
+        "bias": jnp.asarray(rng.standard_normal((768,)), jnp.float32),
+    }
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.standard_normal(p.shape), p.dtype) * 0.01,
+        params)
+    opt = FusedAdam(lr=1e-3, weight_decay=0.01, adamw_mode=True)
+    state = opt.init(params)
+
+    xla_step = jax.jit(lambda g, s, p: opt.update(g, s, p, lr=1e-3))
+    fused_step = jax.jit(
+        lambda g, s, p: fused_adam_apply(opt, g, s, p, lr=1e-3))
+
+    def time_step(fn):
+        p, s = params, state
+        p, s = fn(grads, s, p)                    # compile off the clock
+        jax.block_until_ready(p)
+        t0 = time.time()
+        reps = 5
+        for _ in range(reps):
+            p, s = fn(grads, s, p)
+        jax.block_until_ready(p)
+        return 1e3 * (time.time() - t0) / reps, p
+
+    ms_xla, p_xla = time_step(xla_step)
+    ms_fused, p_fused = time_step(fused_step)
+    err = max(float(jnp.max(jnp.abs(a - b)))
+              for a, b in zip(jax.tree_util.tree_leaves(p_xla),
+                              jax.tree_util.tree_leaves(p_fused)))
+    assert err < 1e-5, f"fused update trajectory diverged ({err})"
+    return {
+        "optimizer_step_xla_ms": round(ms_xla, 3),
+        "optimizer_step_fused_ms": round(ms_fused, 3),
+    }
+
+
 def _section_rows(result, name, **rows):
     """Record one section's metric rows under ``result["sections"]`` — the
     schema ``tools/bench_gate.py`` compares against the committed
@@ -759,6 +869,14 @@ def main():
         # Serving resilience (serving/resilience.py) behind the overload
         # A/B rows; every other serving row runs with resilience off.
         "serving_resilience": dict(SERVING_RESILIENCE_CFG),
+        # Kernel tier round 2 (docs/PERFORMANCE.md): both kernels OFF on
+        # every section except their own A/B rows — chunked admission in
+        # the serving section's chunked_* rows, the fused Adam pass in
+        # the fused_optimizer section. Off is the zero-overhead default
+        # (bit-identical lowered programs, tests/test_chunked_prefill.py
+        # / test_fused_update.py).
+        "chunked_prefill": "off",
+        "fused_update": "off",
     }
 
     if on_tpu:
@@ -921,6 +1039,20 @@ def main():
             f"({time.time() - t0:.0f}s)")
         for key, val in ov.items():
             result[f"serving_{key}"] = val
+        # chunked-prefill admission A/B (docs/SERVING.md "Chunked
+        # prefill admission"): bursty multi-bucket trace, bucketed
+        # per-bucket programs vs the one ragged mixed program —
+        # token-identity asserted inside.
+        t0 = time.time()
+        ck = bench_serving_chunked()
+        log(f"[bench] serving chunked prefill: step "
+            f"{ck['mixed_step_bucketed_ms']:.2f} ms bucketed vs "
+            f"{ck['mixed_step_chunked_ms']:.2f} ms chunked; TTFT p99 "
+            f"{ck['ttft_p99_bucketed_ms']:.1f} ms vs "
+            f"{ck['ttft_p99_chunked_ms']:.1f} ms "
+            f"({time.time() - t0:.0f}s)")
+        for key, val in ck.items():
+            result[f"serving_{key}"] = val
         # tpot/e2e rows are `*_ms`, so bench_gate treats them as
         # lower-is-better automatically (latency regresses upward).
         _section_rows(result, "serving",
@@ -931,7 +1063,7 @@ def main():
                       tpot_p99_ms=result["serving_tpot_p99_ms"],
                       e2e_p99_ms=result["serving_e2e_p99_ms"],
                       mean_occupancy=result["serving_mean_occupancy"],
-                      **fp, **ov)
+                      **fp, **ov, **ck)
 
     def gpt_ab_times(gas, make_config):
         # Shared 2-slice tiny-GPT A/B harness for the comm_overlap and
@@ -1205,12 +1337,29 @@ def main():
             capacity_overflow_frac=round(
                 overflow[-1] if overflow else 0.0, 4))
 
+    def sec_fused_optimizer():
+        # Fused blockwise Adam A/B (docs/PERFORMANCE.md "Kernel tier
+        # round 2"): XLA elementwise chain vs the one-pass Pallas
+        # kernel, same trajectory asserted inside. CPU rows time the
+        # interpreter (informational); the HBM round-trip win is a TPU
+        # round's claim.
+        t0 = time.time()
+        fo = bench_fused_optimizer()
+        log(f"[bench] fused optimizer A/B (~1.05M params, 1 device): "
+            f"xla {fo['optimizer_step_xla_ms']:.2f} ms vs fused "
+            f"{fo['optimizer_step_fused_ms']:.2f} ms "
+            f"({time.time() - t0:.0f}s)")
+        for key, val in fo.items():
+            result[key] = val
+        _section_rows(result, "fused_optimizer", **fo)
+
     sections = [("bert128", sec_bert128)]
     if on_tpu:
         sections += [("bert512", sec_bert512), ("gpt2", sec_gpt2),
                      ("gpt2_dropout", sec_gpt2_dropout), ("long16k", sec_long),
                      ("inference", sec_inference)]
-    sections += [("serving", sec_serving)]
+    sections += [("serving", sec_serving),
+                 ("fused_optimizer", sec_fused_optimizer)]
     # The 2-slice overlap A/B needs an even multi-device split;
     # single-device CPU runs skip it (not a failure — no mesh to build).
     if n_chips_all >= 2 and n_chips_all % 2 == 0:

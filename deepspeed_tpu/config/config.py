@@ -1015,6 +1015,12 @@ class ServingConfig:
     (``max_retries`` / ``retry_base_sec``) and the degradation ladder
     (``degrade_after`` anomalies per rung; ``slow_step_ms`` marks a
     decode step as an anomaly).
+
+    Chunked prefill (docs/SERVING.md "Chunked prefill admission" — off
+    by default, zero-overhead): the ``chunked_prefill`` sub-block
+    switches admission to Sarathi-style mixed steps — decode tokens plus
+    prefill chunks of admitted prompts share ONE ragged program, bounded
+    by ``token_budget`` tokens per step (requires ``temperature == 0``).
     """
 
     max_batch_size: int = C.SERVING_MAX_BATCH_SIZE_DEFAULT
@@ -1040,6 +1046,8 @@ class ServingConfig:
     resil_retry_base_sec: float = C.SERVING_RESIL_RETRY_BASE_SEC_DEFAULT
     resil_degrade_after: int = C.SERVING_RESIL_DEGRADE_AFTER_DEFAULT
     resil_slow_step_ms: Optional[float] = None
+    chunked_prefill: bool = C.SERVING_CHUNKED_ENABLED_DEFAULT
+    chunked_token_budget: int = C.SERVING_CHUNKED_TOKEN_BUDGET_DEFAULT
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -1128,6 +1136,25 @@ class ServingConfig:
             raise ConfigError(
                 f"unknown serving.resilience keys {sorted(unknown)}; "
                 f"expected a subset of {sorted(known_resil)}")
+        chunked = d.get(C.SERVING_CHUNKED_PREFILL)
+        has_chunked = chunked is not None
+        chunked = chunked or {}
+        if not isinstance(chunked, dict):
+            raise ConfigError("serving.chunked_prefill must be a dict")
+        # a present block defaults to enabled (like `resilience`)
+        cfg.chunked_prefill = bool(chunked.get(
+            C.SERVING_CHUNKED_ENABLED,
+            has_chunked or C.SERVING_CHUNKED_ENABLED_DEFAULT))
+        cfg.chunked_token_budget = int(chunked.get(
+            C.SERVING_CHUNKED_TOKEN_BUDGET,
+            C.SERVING_CHUNKED_TOKEN_BUDGET_DEFAULT))
+        known_chunked = {C.SERVING_CHUNKED_ENABLED,
+                         C.SERVING_CHUNKED_TOKEN_BUDGET}
+        unknown = set(chunked) - known_chunked
+        if unknown:
+            raise ConfigError(
+                f"unknown serving.chunked_prefill keys {sorted(unknown)}; "
+                f"expected a subset of {sorted(known_chunked)}")
         if cfg.max_batch_size < 1:
             raise ConfigError("serving.max_batch_size must be >= 1")
         if cfg.kv_block_size < 1:
@@ -1182,6 +1209,17 @@ class ServingConfig:
         if cfg.resil_slow_step_ms is not None and cfg.resil_slow_step_ms <= 0:
             raise ConfigError(
                 "serving.resilience.slow_step_ms must be > 0")
+        if cfg.chunked_token_budget < cfg.max_batch_size:
+            raise ConfigError(
+                "serving.chunked_prefill.token_budget must be >= "
+                "max_batch_size (every decoding slot needs a row in each "
+                "mixed step)")
+        if cfg.chunked_prefill and cfg.temperature != 0.0:
+            raise ConfigError(
+                "serving.chunked_prefill requires temperature == 0 "
+                "(greedy): the mixed program samples every ragged row "
+                "with one key, and the contract with the bucketed path "
+                "is token identity")
         return cfg
 
 
@@ -1559,11 +1597,14 @@ class DeepSpeedTPUConfig:
         opt = d.get(C.OPTIMIZER)
         self.optimizer_name: Optional[str] = None
         self.optimizer_params: Dict[str, Any] = {}
+        self.optimizer_fused_update = C.OPTIMIZER_FUSED_UPDATE_DEFAULT
         if opt is not None:
             if C.OPTIMIZER_TYPE not in opt:
                 raise ConfigError("optimizer block requires a 'type'")
             self.optimizer_name = str(opt[C.OPTIMIZER_TYPE]).lower()
             self.optimizer_params = dict(opt.get(C.OPTIMIZER_PARAMS, {}))
+            self.optimizer_fused_update = bool(opt.get(
+                C.OPTIMIZER_FUSED_UPDATE, C.OPTIMIZER_FUSED_UPDATE_DEFAULT))
         self.optimizer_legacy_fusion = bool(d.get("legacy_fusion", False))
 
         sched = d.get(C.SCHEDULER)

@@ -21,6 +21,11 @@ OPTIMIZER = "optimizer"
 OPTIMIZER_TYPE = "type"
 OPTIMIZER_PARAMS = "params"
 OPTIMIZER_TYPE_DEFAULT = None
+# Fused blockwise Adam(W) update (ops/adam/fused_update.py): one Pallas
+# pass over master + grad + moments per flat block instead of XLA's
+# elementwise chain. Opt-in; requires a device-resident FusedAdam(W).
+OPTIMIZER_FUSED_UPDATE = "fused_update"
+OPTIMIZER_FUSED_UPDATE_DEFAULT = False
 MAX_GRAD_NORM = "max_grad_norm"
 
 SCHEDULER = "scheduler"
@@ -330,6 +335,15 @@ SERVING_RESIL_RETRY_BASE_SEC_DEFAULT = 0.05
 SERVING_RESIL_DEGRADE_AFTER = "degrade_after"  # anomalies per ladder rung
 SERVING_RESIL_DEGRADE_AFTER_DEFAULT = 2
 SERVING_RESIL_SLOW_STEP_MS = "slow_step_ms"  # None -> no slow-step anomaly
+# chunked-prefill sub-block (ops/transformer/chunked_prefill.py;
+# docs/SERVING.md "Chunked prefill admission"): Sarathi-style mixed
+# decode + prefill-chunk steps through ONE ragged program — off by
+# default under the established zero-overhead contract.
+SERVING_CHUNKED_PREFILL = "chunked_prefill"
+SERVING_CHUNKED_ENABLED = "enabled"
+SERVING_CHUNKED_ENABLED_DEFAULT = False
+SERVING_CHUNKED_TOKEN_BUDGET = "token_budget"  # tokens per mixed step
+SERVING_CHUNKED_TOKEN_BUDGET_DEFAULT = 64
 
 #############################################
 # Logging / misc

@@ -50,6 +50,8 @@ def collect_report() -> dict:
         "flash_attention": on_tpu,
         "sparse_attention": on_tpu,
         "paged_decode_attention": on_tpu,
+        "chunked_prefill": on_tpu,
+        "fused_adam_update": on_tpu,
     }
     report["features"] = {
         "pallas_kernels": ", ".join(

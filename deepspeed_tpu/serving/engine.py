@@ -38,7 +38,8 @@ import numpy as np
 
 from deepspeed_tpu.inference.engine import (InferenceEngine, bucket_length,
                                             sample_logits)
-from deepspeed_tpu.serving.kv_cache import (BlockPool, PagedLayerCache,
+from deepspeed_tpu.serving.kv_cache import (BlockPool, ChunkedLayerCache,
+                                            PagedLayerCache,
                                             init_paged_pools, pack_prefill)
 from deepspeed_tpu.serving.scheduler import (PrefixCache, Scheduler,
                                              Sequence)
@@ -74,6 +75,11 @@ SERVING_METRIC_TAGS = frozenset({
     "serving/recoveries",
     "serving/retries",
     "serving/degraded_level",
+    # Chunked prefill (docs/SERVING.md "Chunked prefill admission"):
+    # emitted only when serving.chunked_prefill is on, so the off tag
+    # set stays byte-identical.
+    "serving/chunked_tokens_per_step",
+    "serving/prefill_chunks_in_flight",
 })
 
 
@@ -166,6 +172,39 @@ class ServeEngine:
         self._spec_jits: Dict[Any, Any] = {}
         if self.scfg.spec_decode:
             self._init_speculative()
+        # -- chunked prefill (docs/SERVING.md "Chunked prefill
+        # admission"): the third admission mode. Decode tokens and
+        # prefill CHUNKS of admitted prompts share ONE ragged mixed
+        # program (ops/transformer/chunked_prefill.py), bounded by a
+        # per-step token budget — no per-bucket prefill compiles, no
+        # head-of-line full-prompt stall, one compile ever. Off (the
+        # default) keeps every hook a single attribute check and the
+        # lowered bucketed programs + emitted tag set byte-identical.
+        self._chunked = bool(self.scfg.chunked_prefill)
+        self._chunk_budget = int(self.scfg.chunked_token_budget)
+        self._mixed_jit = None
+        self._chunk_tokens_last = 0
+        if self._chunked:
+            from deepspeed_tpu.ops.transformer.chunked_prefill import \
+                chunked_prefill_ok
+            on_tpu = jax.devices()[0].platform == "tpu"
+            if on_tpu and not chunked_prefill_ok(self.model_cfg.head_dim,
+                                                 bs):
+                # The bucketed path stays the auto fallback (and the
+                # parity oracle) on geometries the compiled kernel
+                # cannot tile; off-TPU the Pallas interpreter takes any
+                # shape.
+                log_dist(
+                    f"serving: chunked prefill requested but head_dim="
+                    f"{self.model_cfg.head_dim}/block_size={bs} does not "
+                    f"tile the kernel — falling back to bucketed "
+                    f"admission", ranks=[0])
+                self._chunked = False
+            else:
+                log_dist(
+                    f"serving: chunked prefill on — token budget "
+                    f"{self._chunk_budget}/step, one mixed program",
+                    ranks=[0])
         # Request observatory (telemetry/requests.py): per-request SLO
         # ledger + engine serving-time partition. None (the default and
         # the telemetry.requests=off state) keeps every hook a single
@@ -354,6 +393,19 @@ class ServeEngine:
             seq = self.sched.try_admit(self._bucket_of, self._step_count)
             if seq is None:
                 break
+            if self._chunked:
+                # Chunked admission: no prefill dispatch here — the
+                # prompt enters the mixed program in budget-bounded
+                # chunks starting at the adopted prefix head. First
+                # token, prefix registration and the ``prefilled``
+                # report land when the LAST chunk completes
+                # (_mixed_round).
+                seq.pos = seq.prefilled = seq.shared_len
+                if acc is not None:
+                    acc.engine_mark("scheduler_admission")
+                self.stats["slot_assignments"].setdefault(seq.slot, 0)
+                self.stats["slot_assignments"][seq.slot] += 1
+                continue
             if acc is not None:
                 acc.engine_mark("scheduler_admission")
                 n_jits = len(self._prefill_jit) + len(self._tail_prefill_jit)
@@ -385,7 +437,8 @@ class ServeEngine:
         n_tokens = 0
         if active:
             if acc is not None:
-                n_djits = len(self._decode_jits) + len(self._spec_jits)
+                n_djits = (len(self._decode_jits) + len(self._spec_jits)
+                           + int(self._mixed_jit is not None))
             if self._resil is not None:
                 n_tokens, dt_decode, active = self._resil.run_decode(
                     active, info)
@@ -393,8 +446,8 @@ class ServeEngine:
             else:
                 n_tokens, dt_decode = self._decode_round(active, info)
             if acc is not None:
-                grew = (len(self._decode_jits)
-                        + len(self._spec_jits)) > n_djits
+                grew = (len(self._decode_jits) + len(self._spec_jits)
+                        + int(self._mixed_jit is not None)) > n_djits
                 acc.engine_mark("compile" if grew else "decode")
                 still = [s for s in active
                          if self.sched.running.get(s.slot) is s]
@@ -462,6 +515,13 @@ class ServeEngine:
     # internals
     # ------------------------------------------------------------------
     def _bucket_of(self, t: int) -> int:
+        if self._chunked:
+            # Chunked admission sizes exactly (whole blocks, no pow2
+            # rounding): there are no per-bucket compiles to amortize —
+            # the ragged program takes any length — so neither KV
+            # blocks nor prefill compute ever pay bucket rounding.
+            return min(-(-t // self.block_size) * self.block_size,
+                       self.bucket_cap)
         b = bucket_length(t, cap=self.bucket_cap)
         b = -(-b // self.block_size) * self.block_size   # whole blocks
         return min(max(b, -(-t // self.block_size) * self.block_size),
@@ -629,6 +689,9 @@ class ServeEngine:
         next decode step as usual. No TTFT observation, no token
         append, no quant-error measure — the request already paid its
         real prefill."""
+        if self._chunked:
+            self._replay_chunked(seq, replay)
+            return
         t = len(replay)
         rng = jax.random.fold_in(self._base_key, 2 * seq.request.rid + 1)
         if seq.shared_len:
@@ -672,6 +735,24 @@ class ServeEngine:
                 self.engine.params, dev_ids, length, rng)
             blocks = jnp.asarray(seq.block_table, jnp.int32)
             self._pools = self._pack_jit(self._pools, blocks, ks, vs)
+
+    def _replay_chunked(self, seq: Sequence, replay: List[int]) -> None:
+        """Chunked-mode replay: rebuild ``[shared_len, len(replay))`` in
+        the fresh pools through the SAME mixed program as live traffic —
+        no per-bucket replay variants to compile. Samples are discarded
+        (greedy: they equal the recorded tokens); the seq's cursors
+        already reflect its pre-crash state, only pool contents need
+        rebuilding. Resilience only routes fully-prefilled sequences
+        here (a mid-prefill seq is cold-requeued instead)."""
+        t0, total = seq.shared_len, len(replay)
+        while t0 < total:
+            c = min(self._chunk_budget, total - t0)
+            rows = [(seq.slot, replay[t0 + i], t0 + i) for i in range(c)]
+            with self.telemetry.span("prefill", rid=seq.request.rid,
+                                     bucket=seq.bucket, prompt_len=total,
+                                     replay=1):
+                self._mixed_dispatch([seq], rows, 1)
+            t0 += c
 
     def _prefill_tail_impl(self, params, pools, ids, bt, start, length,
                            rng, *, tail_bucket: int):
@@ -723,6 +804,18 @@ class ServeEngine:
         untouched); the resilience manager wraps THIS boundary, where a
         failed dispatch has mutated nothing."""
         t_dec = time.perf_counter()
+        if self._chunked:
+            # The mixed ragged program serves every round that has a
+            # prefill chunk in flight — and, without speculative
+            # decoding, every round (the all-decode batch is just the
+            # degenerate ragged case; one program ever). With spec on,
+            # rounds with no chunk in flight fall through to the
+            # speculative path (greedy-identical either way).
+            prefilling = any(s.prefilled < len(s.request.prompt)
+                             for s in active)
+            if prefilling or not self._spec_k:
+                n_tokens = self._mixed_round(active, info)
+                return n_tokens, time.perf_counter() - t_dec
         if self._spec_k:
             n_tokens = self._spec_round(active, info)
             dt_decode = time.perf_counter() - t_dec
@@ -757,6 +850,22 @@ class ServeEngine:
             else:
                 self.submit(prompt, max_new, eos)
 
+    def _fault_hook(self) -> None:
+        """Serving chaos rides the decode DISPATCH attempt counter:
+        monotonic across steps AND retries, so a fault window of width k
+        is consumed by k dispatch attempts (a transient fault heals
+        under retry; a wider window forces the rebuild path). Raising
+        here mutates nothing — pools are only donated by a dispatch
+        that actually runs. Shared by the bucketed/spec dispatch prep
+        and the chunked mixed dispatch, so chaos covers all three."""
+        if self._fault is None:
+            return
+        self._dispatch_attempts += 1
+        if self._fault.should_serve_decode_fault(self._dispatch_attempts):
+            self._fault.serve_decode_fault(self._dispatch_attempts)
+        if self._fault.should_serve_slow_step(self._dispatch_attempts):
+            self._fault.serve_slow_step()
+
     def _batch_inputs(self, active: List[Sequence]):
         """Host-side decode batch matrices (inactive rows -> scratch)."""
         nb, mb = self.scfg.max_batch_size, self.max_blocks
@@ -789,19 +898,7 @@ class ServeEngine:
         bucket when capped), the jit-cache key, the resolved attention
         impl, and the gathered-positions evidence — ONE accounting for
         both paths so they cannot drift."""
-        if self._fault is not None:
-            # Serving chaos rides the decode DISPATCH attempt counter:
-            # monotonic across steps AND retries, so a fault window of
-            # width k is consumed by k dispatch attempts (a transient
-            # fault heals under retry; a wider window forces the
-            # rebuild path). Raising here mutates nothing — pools are
-            # only donated by a dispatch that actually runs.
-            self._dispatch_attempts += 1
-            if self._fault.should_serve_decode_fault(
-                    self._dispatch_attempts):
-                self._fault.serve_decode_fault(self._dispatch_attempts)
-            if self._fault.should_serve_slow_step(self._dispatch_attempts):
-                self._fault.serve_slow_step()
+        self._fault_hook()
         mb = self.max_blocks
         bt, pos, toks = self._batch_inputs(active)
         if self._fast_path:
@@ -847,6 +944,117 @@ class ServeEngine:
         tok = sample_logits(logits, rng, self.scfg.temperature,
                             self.scfg.top_k)
         return tok, logits, tuple(c.pools for c in out["cache"])
+
+    # -- chunked prefill (the mixed ragged round) -----------------------
+    def _mixed_round(self, active: List[Sequence],
+                     info: Dict[str, Any]) -> int:
+        """One mixed step: every decoding sequence advances one token
+        AND waiting prompts prefill in chunks, all through ONE ragged
+        program. Rows: decode tokens first (each decoding slot must
+        advance — the token budget is validated >= max_batch_size),
+        then prefill chunks FCFS by admission until the budget is full.
+        A prompt whose last chunk lands this step samples its first
+        token from that chunk's final row — exactly the logits the
+        bucketed prefill samples from, so outputs are token-identical.
+        Returns the number of tokens appended."""
+        if self.capture_logits:
+            raise ValueError(
+                "capture_logits is not supported with chunked prefill — "
+                "a mixed step has no per-slot logits row to expose "
+                "(docs/SERVING.md)")
+        decoding = [s for s in active
+                    if s.prefilled >= len(s.request.prompt)]
+        prefilling = sorted(
+            (s for s in active if s.prefilled < len(s.request.prompt)),
+            key=lambda s: (s.admitted_step, s.request.rid))
+        self._fault_hook()   # live rounds only — replay never injects
+        rows = [(s.slot, s.tokens[-1], s.pos) for s in decoding]
+        chunks = []                              # (seq, first_row, count)
+        for s in prefilling:
+            if len(rows) >= self._chunk_budget:
+                break
+            t0 = s.prefilled
+            c = min(len(s.request.prompt) - t0,
+                    self._chunk_budget - len(rows))
+            chunks.append((s, len(rows), c))
+            rows.extend((s.slot, s.request.prompt[t0 + i], t0 + i)
+                        for i in range(c))
+        tok_host = self._mixed_dispatch(active, rows, len(active))
+        self._chunk_tokens_last = len(rows)
+        appended = 0
+        for r, seq in enumerate(decoding):
+            seq.tokens.append(int(tok_host[r]))
+            seq.pos += 1
+            appended += 1
+            if seq.finished():
+                self._finish(seq, info)
+        for seq, r0, c in chunks:
+            seq.prefilled += c
+            seq.pos = seq.prefilled
+            if seq.prefilled == len(seq.request.prompt):
+                # Prompt complete: the chunk's final row sits at the
+                # last prompt position — its sampled token is the first
+                # generated token (TTFT lands here).
+                self._record_first_token(seq, int(tok_host[r0 + c - 1]))
+                appended += 1
+                if self._req_acc is not None:
+                    self._req_acc.on_prefilled(seq)
+                self.sched.register_prefix(seq, self._step_count)
+                info["prefilled"].append(seq.request.rid)
+                if seq.finished():   # max_new_tokens == 1 / instant EOS
+                    self._finish(seq, info)
+        return appended
+
+    def _mixed_dispatch(self, table_seqs: List[Sequence], rows,
+                        n_active: int):
+        """Dispatch one ragged token batch. ``rows``: ``(slot, token,
+        position)`` triples (decode rows then chunk rows); the batch is
+        padded to the token budget with scratch rows — slot
+        ``max_batch_size`` maps to the spare all-zeros table row, so pad
+        writes land in the reserved scratch block and pad reads stay
+        masked. ONE detector scope, ONE jit entry, ever: every mixed
+        step has the same signature regardless of the decode/prefill
+        mix."""
+        nb, mb = self.scfg.max_batch_size, self.max_blocks
+        bt = np.zeros((nb + 1, mb), np.int32)    # row nb: pad/scratch row
+        toks = np.zeros((self._chunk_budget,), np.int32)
+        pos = np.zeros((self._chunk_budget,), np.int32)
+        slots = np.full((self._chunk_budget,), nb, np.int32)
+        for seq in table_seqs:
+            bt[seq.slot, :len(seq.block_table)] = seq.block_table
+        for r, (sl, tk, p) in enumerate(rows):
+            slots[r], toks[r], pos[r] = sl, tk, p
+        bt, pos, toks, slots = (jnp.asarray(bt), jnp.asarray(pos),
+                                jnp.asarray(toks), jnp.asarray(slots))
+        self.engine.recompile_detector.check("serving.mixed_step", toks,
+                                             pos, slots, bt)
+        if self._mixed_jit is None:
+            self._mixed_jit = jax.jit(self._mixed_impl,
+                                      donate_argnums=(1,))
+        rng = jax.random.fold_in(self._base_key, 2 * self._step_count)
+        with self.telemetry.span("mixed_step", active=n_active,
+                                 tokens=len(rows)):
+            tok_dev, self._pools = self._mixed_jit(
+                self.engine.params, self._pools, bt, pos, slots, toks,
+                rng)
+            tok_host = np.asarray(tok_dev)       # host fetch: finish checks
+        return tok_host
+
+    def _mixed_impl(self, params, pools, bt, pos, slots, toks, rng):
+        max_pos = self.model_cfg.max_seq_len - 1
+        cache = tuple(
+            ChunkedLayerCache(*pools[i], bt, slots, pos, self.block_size,
+                              self._dtype_name)
+            for i in range(self.model_cfg.num_layers))
+        out = self.module.apply(
+            {"params": self.engine._materialized(params)},
+            {"input_ids": toks[None, :],
+             "position_ids": jnp.minimum(pos, max_pos)[None, :]},
+            deterministic=True, cache=cache, pos=None)
+        logits = out["logits"][0].astype(jnp.float32)      # [T, V]
+        tok = sample_logits(logits, rng, self.scfg.temperature,
+                            self.scfg.top_k)
+        return tok, tuple(c.pools for c in out["cache"])
 
     # -- speculative decoding -------------------------------------------
     def _init_speculative(self) -> None:
@@ -1093,6 +1301,14 @@ class ServeEngine:
                 ctr = reg.counter(tag)
                 if total > ctr.total:
                     ctr.inc(total - ctr.total, step=step)
+        # -- chunked-prefill admission (only when the mode is on: the
+        # serving.chunked_prefill=off tag set stays byte-identical) -----
+        if self._chunked:
+            reg.gauge("serving/chunked_tokens_per_step").set(
+                self._chunk_tokens_last, step=step)
+            reg.gauge("serving/prefill_chunks_in_flight").set(
+                sum(1 for s in self.sched.running.values()
+                    if s.prefilled < len(s.request.prompt)), step=step)
 
     def close(self) -> None:
         """Flush AND close the telemetry this engine drives (sink file

@@ -294,6 +294,94 @@ class PagedLayerCache:
         return new, o.astype(q.dtype)
 
 
+@jax.tree_util.register_pytree_node_class
+class ChunkedLayerCache:
+    """One layer's view of the paged cache inside the **mixed** (chunked
+    prefill) program: the batch axis is a flat ragged token batch
+    ``[T]`` — decode tokens plus prefill chunks — where token ``t``
+    belongs to batch slot ``slots[t]`` and sits at cache position
+    ``pos[t]`` of its sequence. Pad tokens carry the spare all-scratch
+    table row, so their writes land in block 0 and their (discarded)
+    attention reads stay masked.
+
+    Used by the GPT family's paged branch exactly like
+    :class:`PagedLayerCache` with ``attn_impl == "kernel"`` — the model
+    hands a ``[1, T, H, D]`` chunk to :meth:`update_attend` and gets the
+    attended output back; visibility is per ragged segment
+    (``kpos <= pos[t]`` over the token's own block-table row), which is
+    exactly the bucketed path's causal semantics, so the two paths are
+    token-identical (tier-1 parity-tested in
+    tests/test_chunked_prefill.py).
+    """
+
+    attn_impl = "chunked"       # static: routes the model's paged branch
+
+    def __init__(self, k: jax.Array, v: jax.Array,
+                 k_scale: Optional[jax.Array], v_scale: Optional[jax.Array],
+                 block_table: jax.Array, slots: jax.Array, pos: jax.Array,
+                 block_size: int, dtype_name: str = "bfloat16"):
+        self.k = k
+        self.v = v
+        self.k_scale = k_scale
+        self.v_scale = v_scale
+        self.block_table = block_table      # [B + 1, MB] int32 (row B: pads)
+        self.slots = slots                  # [T] int32 — token's batch slot
+        self.pos = pos                      # [T] int32 — token's position
+        self.block_size = int(block_size)
+        self.dtype_name = dtype_name
+
+    # -- pytree ---------------------------------------------------------
+    def tree_flatten(self):
+        return ((self.k, self.v, self.k_scale, self.v_scale,
+                 self.block_table, self.slots, self.pos),
+                (self.block_size, self.dtype_name))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, block_size=aux[0], dtype_name=aux[1])
+
+    @property
+    def int8(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def pools(self) -> Tuple:
+        return (self.k, self.v, self.k_scale, self.v_scale)
+
+    # -- traced ops -----------------------------------------------------
+    def _write(self, pool, scale, chunk):
+        """Scatter ``chunk`` [T, H, D] — one write per ragged token at
+        its own ``(slot, pos)``. Pad tokens all collide on the scratch
+        block; real tokens never do (positions within a sequence are
+        distinct and tables are disjoint)."""
+        blk = self.block_table[self.slots, self.pos // self.block_size]
+        off = self.pos % self.block_size                         # [T]
+        if scale is not None:
+            q, sc = _quant_tokens(chunk)
+            return pool.at[blk, off].set(q), scale.at[blk, off].set(sc)
+        return pool.at[blk, off].set(chunk.astype(pool.dtype)), None
+
+    def update_attend(self, q: jax.Array, k_new: jax.Array,
+                      v_new: jax.Array,
+                      softmax_scale: Optional[float] = None):
+        """Write the ragged batch's K/V, then run the chunked-prefill
+        kernel straight over the pools through per-token block tables.
+        ``q``/``k_new``/``v_new``: [1, T, H, D] (the model's flat batch
+        rides as one row). Returns ``(new_cache, o [1, T, H, D])``."""
+        from deepspeed_tpu.ops.transformer.chunked_prefill import \
+            chunked_prefill_attention
+
+        k, ks = self._write(self.k, self.k_scale, k_new[0])
+        v, vs = self._write(self.v, self.v_scale, v_new[0])
+        new = ChunkedLayerCache(k, v, ks, vs, self.block_table, self.slots,
+                                self.pos, self.block_size, self.dtype_name)
+        table = self.block_table[self.slots]                     # [T, MB]
+        o = chunked_prefill_attention(q[0], k, v, ks, vs, table, self.pos,
+                                      block_size=self.block_size,
+                                      softmax_scale=softmax_scale)
+        return new, o[None].astype(q.dtype)
+
+
 def pack_prefill(pools: Tuple, blocks: jax.Array,
                  k_stack: jax.Array, v_stack: jax.Array) -> Tuple:
     """Scatter a prefilled contiguous cache into pool blocks (jit this).

@@ -346,6 +346,7 @@ class ResilienceManager:
             int8=eng.scfg.int8_kv_cache, dtype=eng._dtype)
         eng._decode_jits.clear()
         eng._spec_jits.clear()
+        eng._mixed_jit = None   # donates the pools — old program's dead
         replayed = requeued = 0
         for seq in live:
             if self._replay(seq):
@@ -371,6 +372,12 @@ class ResilienceManager:
         sched = eng.sched
         replay = seq.tokens[:-1]
         if not replay or len(replay) > eng.bucket_cap:
+            return False
+        if eng._chunked and seq.prefilled < len(seq.request.prompt):
+            # Mid-prefill chunked sequence: its prompt KV is only
+            # partially written and it has sampled nothing, so a
+            # tokens[:-1] replay can't express it. Cold requeue
+            # restarts the prompt — always correct.
             return False
         bucket = eng._bucket_of(len(replay))
         shared: List[int] = []

@@ -90,6 +90,12 @@ class Sequence:
     # (always a whole-block multiple; 0 = cold). The engine prefills only
     # the tail [shared_len, len(prompt)).
     shared_len: int = 0
+    # Chunked-prefill cursor: prompt positions [0, prefilled) have their
+    # KV written. The bucketed path prefills whole prompts at admission
+    # and never reads this; the chunked engine advances it budget-bounded
+    # chunks at a time until it reaches len(prompt) (docs/SERVING.md
+    # "Chunked prefill admission").
+    prefilled: int = 0
 
     @property
     def last_write_pos(self) -> int:

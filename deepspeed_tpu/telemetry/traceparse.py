@@ -92,11 +92,15 @@ def classify_op(name: str) -> Optional[str]:
         return "collective"
     stem = op_stem(name)
     if (stem in _MATMUL_STEMS or "gemm" in stem or "matmul" in stem
-            or "einsum" in stem):
+            or "einsum" in stem or "attention" in stem):
+        # Pallas attention kernels (flash/paged/chunked_prefill) surface
+        # as custom-call events named after the kernel fn — their cycles
+        # are MXU work.
         return "matmul"
     if stem in _COPY_STEMS:
         return "copy"
-    if stem in _ELEMENTWISE_STEMS or "fusion" in stem:
+    if stem in _ELEMENTWISE_STEMS or "fusion" in stem or "adam" in stem:
+        # fused_adam_update_kernel: one VPU pass over the flat blocks.
         return "elementwise"
     return "other"
 

@@ -55,6 +55,8 @@ NOISE_FLOORS = {
     "serving": 0.15,
     # dispatch A/B: tiny model, few steps per window -> coarse timing
     "moe_gpt": 0.12,
+    # optimizer-step A/B: sub-ms windows on a ~1M-param tree
+    "fused_optimizer": 0.15,
 }
 DEFAULT_FLOOR = 0.10
 
@@ -272,6 +274,36 @@ def _selftest() -> int:
     rep = compare(moe_base, slow_a2a)
     bad = [r for r in rep["rows"] if r["verdict"] == "REGRESSION"]
     assert len(bad) == 1 and bad[0]["metric"] == "step_time_alltoall_ms", rep
+    # 4d. kernel tier round 2 rows (bench.py bench_serving_chunked /
+    #     bench_fused_optimizer): the serving chunked A/B rows and the
+    #     fused_optimizer section are informational against an old
+    #     baseline; once adopted, all of them are _ms rows and gate in
+    #     the latency direction (a slower chunked mixed step or fused
+    #     update is a regression even though the number went UP).
+    k2_serving = {**baseline["sections"]["serving"],
+                  "mixed_step_bucketed_ms": 9.0,
+                  "mixed_step_chunked_ms": 7.0,
+                  "ttft_p99_bucketed_ms": 120.0,
+                  "ttft_p99_chunked_ms": 60.0}
+    k2_fused = {"optimizer_step_xla_ms": 2.0,
+                "optimizer_step_fused_ms": 1.5}
+    with_k2 = {"sections": {**baseline["sections"],
+                            "serving": k2_serving,
+                            "fused_optimizer": k2_fused}}
+    rep = compare(baseline, with_k2)
+    assert rep["ok"], rep
+    assert "serving/mixed_step_chunked_ms" in rep["new_metrics"], rep
+    assert "fused_optimizer/optimizer_step_fused_ms" in rep["new_metrics"], \
+        rep
+    k2_base = {"sections": {"serving": k2_serving,
+                            "fused_optimizer": k2_fused}}
+    slow_k2 = {"sections": {
+        "serving": {**k2_serving, "ttft_p99_chunked_ms": 110.0},
+        "fused_optimizer": {**k2_fused, "optimizer_step_fused_ms": 2.5}}}
+    rep = compare(k2_base, slow_k2)
+    bad = sorted(r["metric"] for r in rep["rows"]
+                 if r["verdict"] == "REGRESSION")
+    assert bad == ["optimizer_step_fused_ms", "ttft_p99_chunked_ms"], rep
     # 5. legacy flat-key bench JSONs map onto sections
     legacy = sections_of({"value": 532.98, "gpt2_tokens_per_sec": 147691.0,
                           "serving_ttft_p50_ms": 9.1, "metric": "x",
